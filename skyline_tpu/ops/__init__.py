@@ -1,0 +1,27 @@
+"""Dominance + skyline kernels (the TPU replacement for the reference's BNL loop)."""
+
+from skyline_tpu.ops.dominance import (
+    PAD_VALUE,
+    dominance_mask,
+    dominated_by,
+    dominates,
+    pad_window,
+    skyline_mask,
+    skyline_np,
+)
+from skyline_tpu.ops.block_skyline import (
+    skyline_mask_blocked,
+    skyline_large,
+)
+
+__all__ = [
+    "PAD_VALUE",
+    "dominates",
+    "dominance_mask",
+    "dominated_by",
+    "skyline_mask",
+    "skyline_np",
+    "pad_window",
+    "skyline_mask_blocked",
+    "skyline_large",
+]

@@ -1,0 +1,168 @@
+"""Dense dominance / skyline primitives (pure jnp, jit-friendly, static shapes).
+
+Skyline semantics match the reference exactly (ServiceTuple.java:67-77):
+*minimization* in all dimensions — tuple ``a`` **dominates** ``b`` iff
+``a[k] <= b[k]`` for every dimension ``k`` AND ``a[k] < b[k]`` for at least one.
+The skyline of a set is its non-dominated subset. Duplicates do not dominate
+each other, so all copies of a duplicated skyline point survive (the reference
+behaves the same way — its 2D correlated run reports 1,716 skyline points all
+equal to [0, 0], SURVEY.md §4).
+
+Padding convention: invalid/padding rows hold ``PAD_VALUE = +inf`` in every
+dimension. Under minimization a +inf row can never dominate anything (its
+coordinates are never <=), so padding is dominance-neutral as a *dominator*.
+Padding rows are additionally excluded via explicit validity masks so they are
+never reported as survivors. This keeps every kernel free of dynamic shapes:
+callers pad windows to bucket sizes and carry ``(values, valid)`` pairs.
+
+These dense kernels materialize an (N, M) pairwise bitmask and are meant for
+tiles up to ~8-16k points. Larger windows go through
+``skyline_tpu.ops.block_skyline`` which tiles these primitives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# +inf padding is dominance-neutral under minimization (see module docstring).
+PAD_VALUE = jnp.inf
+
+
+def dominates(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Scalar-pair dominance predicate: does point ``a`` dominate point ``b``?
+
+    a, b: (d,) arrays. Returns a scalar bool. Mirrors ServiceTuple.dominates
+    (ServiceTuple.java:67-77): all(<=) and any(<) under minimization.
+    """
+    return jnp.all(a <= b) & jnp.any(a < b)
+
+
+def dominance_mask(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Pairwise dominance bitmask between two point sets.
+
+    x: (N, d), y: (M, d). Returns dom (N, M) bool with
+    ``dom[i, j] = x[i] dominates y[j]``.
+
+    This is the vectorized replacement for the reference's BNL double loop
+    (FlinkSkyline.java:424-437): one fused comparison grid instead of
+    tuple-at-a-time pointer chasing.
+    """
+    # (N, 1, d) vs (1, M, d) broadcast; XLA fuses the comparisons and the
+    # reductions into a single elementwise+reduce kernel.
+    le = jnp.all(x[:, None, :] <= y[None, :, :], axis=-1)
+    lt = jnp.any(x[:, None, :] < y[None, :, :], axis=-1)
+    return le & lt
+
+
+def dominated_by(y: jax.Array, x: jax.Array, x_valid: jax.Array | None = None) -> jax.Array:
+    """For each point in ``y``, is it dominated by ANY valid point in ``x``?
+
+    y: (M, d) candidates; x: (N, d) potential dominators;
+    x_valid: (N,) bool or None (all valid). Returns (M,) bool.
+    """
+    dom = dominance_mask(x, y)  # (N, M)
+    if x_valid is not None:
+        dom = dom & x_valid[:, None]
+    return jnp.any(dom, axis=0)
+
+
+def skyline_mask(x: jax.Array, valid: jax.Array | None = None) -> jax.Array:
+    """Survivor mask of a point set: ``out[j]`` = x[j] is valid and non-dominated.
+
+    x: (N, d); valid: (N,) bool or None. A point survives iff no *valid* point
+    dominates it. Dense O(N^2 d); use for tiles.
+    """
+    dominated = dominated_by(x, x, x_valid=valid)
+    keep = ~dominated
+    if valid is not None:
+        keep = keep & valid
+    return keep
+
+
+def pad_window(x: np.ndarray | jax.Array, capacity: int):
+    """Pad an (n, d) window up to (capacity, d) with PAD_VALUE; return (values, valid)."""
+    n, d = x.shape
+    if n > capacity:
+        raise ValueError(f"window of {n} rows exceeds capacity {capacity}")
+    pad = jnp.full((capacity - n, d), PAD_VALUE, dtype=jnp.result_type(x, jnp.float32))
+    values = jnp.concatenate([jnp.asarray(x, dtype=pad.dtype), pad], axis=0)
+    valid = jnp.arange(capacity) < n
+    return values, valid
+
+
+def skyline_np(x: np.ndarray) -> np.ndarray:
+    """Numpy oracle: exact skyline of (n, d) points, O(n^2 d), host-side.
+
+    The property-test reference implementation (SURVEY.md §4's "O(n^2)-free
+    reference oracle" — kept simple and obviously correct rather than fast).
+    """
+    x = np.asarray(x, dtype=np.float64)
+    n = x.shape[0]
+    if n == 0:
+        return x
+    keep = np.ones(n, dtype=bool)
+    for i in range(n):
+        if not keep[i]:
+            # Dominated points are redundant dominators (dominance is
+            # transitive), safe to skip.
+            continue
+        le = np.all(x[i] <= x, axis=1)
+        lt = np.any(x[i] < x, axis=1)
+        dominated = le & lt
+        dominated[i] = False
+        keep &= ~dominated
+    return x[keep]
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def merge_skylines(
+    a: jax.Array,
+    a_valid: jax.Array,
+    b: jax.Array,
+    b_valid: jax.Array,
+    capacity: int,
+):
+    """Union-merge two skyline buffers into one padded buffer of ``capacity``.
+
+    Implements the merge law the two-phase design relies on
+    (skyline(A ∪ B) == skyline(skyline(A) ∪ skyline(B)), SURVEY.md §4):
+    cross-prune each side against the other, then compact survivors to the
+    front. Inputs need not be skylines already — any padded (values, valid)
+    buffers work. Returns (values (capacity, d), valid (capacity,), count).
+
+    This replaces the GlobalSkylineAggregator's incremental BNL merge
+    (FlinkSkyline.java:547-566) with one masked dominance pass.
+    """
+    x = jnp.concatenate([a, b], axis=0)
+    valid = jnp.concatenate([a_valid, b_valid], axis=0)
+    keep = skyline_mask(x, valid)
+    return compact(x, keep, capacity)
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def compact(x: jax.Array, keep: jax.Array, capacity: int):
+    """Pack kept rows to the front of a fixed-size buffer (jit-friendly compaction).
+
+    x: (N, d), keep: (N,) bool. Returns (values (capacity, d), valid
+    (capacity,), count). Rows beyond ``count`` are PAD_VALUE. If more than
+    ``capacity`` rows are kept, the overflow is silently dropped — callers
+    size capacity to the worst case (or check ``count``).
+    """
+    n = x.shape[0]
+    count = jnp.sum(keep)
+    # Stable order: kept rows first, original order preserved within groups.
+    order = jnp.argsort(~keep, stable=True)
+    x_sorted = x[order]
+    slot = jnp.arange(capacity)
+    valid = slot < jnp.minimum(count, capacity)
+    if capacity <= n:
+        vals = x_sorted[:capacity]
+    else:
+        pad = jnp.full((capacity - n, x.shape[1]), PAD_VALUE, dtype=x.dtype)
+        vals = jnp.concatenate([x_sorted, pad], axis=0)
+    vals = jnp.where(valid[:, None], vals, PAD_VALUE)
+    return vals, valid, count

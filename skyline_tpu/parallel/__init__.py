@@ -1,0 +1,23 @@
+"""Partitioning strategies + sharded two-phase skyline over a TPU mesh."""
+
+from skyline_tpu.parallel.partitioners import (
+    PARTITIONERS,
+    mr_angle,
+    mr_dim,
+    mr_grid,
+    partition_ids,
+)
+from skyline_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_two_phase_skyline,
+)
+
+__all__ = [
+    "PARTITIONERS",
+    "mr_dim",
+    "mr_grid",
+    "mr_angle",
+    "partition_ids",
+    "make_mesh",
+    "sharded_two_phase_skyline",
+]

@@ -1,0 +1,41 @@
+"""Test harness: force an 8-virtual-device CPU platform BEFORE jax imports.
+
+Multi-chip TPU hardware is not available in CI; per SURVEY.md §4 item 5 the
+reference simulates distribution with a local Flink mini-cluster — our
+equivalent is XLA's host-platform device-count override, which exercises the
+full shard_map/collective path on 8 virtual devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment's TPU plugin (axon) imports jax at interpreter startup, so
+# the env vars above can be too late; the backend itself is still
+# uninitialized at conftest time, so a config update takes effect.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def sorted_rows(a):
+    """Canonical row order for comparing point sets as multisets."""
+    a = np.asarray(a, dtype=np.float64)
+    return a[np.lexsort(a.T[::-1])]
+
+
+def assert_same_set(a, b):
+    np.testing.assert_allclose(sorted_rows(a), sorted_rows(b))

@@ -1,0 +1,89 @@
+"""Partitioner unit tests: bounds, determinism, completeness, formula parity."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skyline_tpu.parallel import mr_angle, mr_dim, mr_grid, partition_ids
+from skyline_tpu.parallel.partitioners import mr_grid_cell
+
+DOMAIN = 1000.0
+
+
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-grid", "mr-angle"])
+@pytest.mark.parametrize("num_partitions", [1, 2, 8, 16])
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_bounds_and_determinism(rng, algo, num_partitions, d):
+    x = jnp.asarray(rng.uniform(0, DOMAIN, size=(500, d)).astype(np.float32))
+    p1 = np.asarray(partition_ids(x, algo, num_partitions, DOMAIN))
+    p2 = np.asarray(partition_ids(x, algo, num_partitions, DOMAIN))
+    np.testing.assert_array_equal(p1, p2)
+    assert p1.dtype == np.int32
+    assert (p1 >= 0).all() and (p1 < num_partitions).all()
+
+
+def test_mr_dim_formula(rng):
+    # p = floor(v0 / (domain / P)) clamped — FlinkSkyline.java:707-712
+    x = rng.uniform(0, DOMAIN, size=(200, 3)).astype(np.float32)
+    p = np.asarray(mr_dim(jnp.asarray(x), 8, DOMAIN))
+    expect = np.clip(np.floor(x[:, 0] / (DOMAIN / 8)).astype(np.int64), 0, 7)
+    np.testing.assert_array_equal(p, expect)
+
+
+def test_mr_dim_clamps_domain_edge():
+    x = jnp.asarray([[DOMAIN, 0.0], [0.0, 0.0]], dtype=jnp.float32)
+    p = np.asarray(mr_dim(x, 4, DOMAIN))
+    assert list(p) == [3, 0]
+
+
+def test_mr_grid_cell_bitmask():
+    # bit i set iff v_i >= domain/2 — FlinkSkyline.java:773-789
+    x = jnp.asarray(
+        [[100.0, 900.0], [900.0, 100.0], [900.0, 900.0], [100.0, 100.0]],
+        dtype=jnp.float32,
+    )
+    cells = np.asarray(mr_grid_cell(x, DOMAIN))
+    assert list(cells) == [2, 1, 3, 0]
+
+
+def test_mr_grid_completeness_high_dims(rng):
+    # The deliberate fix vs the reference's J4 bug (SURVEY.md §2.1): with
+    # d > log2(P) every tuple must still land on a partition in [0, P).
+    x = jnp.asarray(rng.uniform(0, DOMAIN, size=(1000, 8)).astype(np.float32))
+    p = np.asarray(mr_grid(x, 4, DOMAIN))
+    assert (p >= 0).all() and (p < 4).all()
+    # and the fold is the documented modulo of the reference cell id
+    cells = np.asarray(mr_grid_cell(x, DOMAIN))
+    np.testing.assert_array_equal(p, cells % 4)
+
+
+def test_mr_angle_2d_sectors():
+    # 2D: phi = atan2(v1, v0) / (pi/2); small angle -> low partition.
+    x = jnp.asarray(
+        [[1000.0, 1.0], [1.0, 1000.0], [500.0, 500.0]], dtype=jnp.float32
+    )
+    p = np.asarray(mr_angle(x, 4, DOMAIN))
+    assert p[0] == 0  # nearly along dim-0 axis
+    assert p[1] == 3  # nearly along dim-1 axis
+    assert p[2] in (1, 2)  # diagonal
+
+
+def test_mr_angle_matches_scalar_formula(rng):
+    # Vectorized arctan2 cascade == per-tuple formula (FlinkSkyline.java:839-874)
+    x = rng.uniform(1e-3, DOMAIN, size=(100, 5)).astype(np.float64)
+    P = 8
+    got = np.asarray(mr_angle(jnp.asarray(x.astype(np.float32)), P, DOMAIN))
+    for row, want in zip(x, got):
+        d = len(row)
+        phis = []
+        for i in range(d - 1):
+            tail = np.sqrt(np.sum(row[i + 1 :] ** 2))
+            phis.append(np.arctan2(tail, row[i]))
+        avg = np.mean([ph / (np.pi / 2) for ph in phis])
+        expect = int(np.clip(np.floor(avg * P), 0, P - 1))
+        assert want == expect
+
+
+def test_partition_ids_rejects_unknown():
+    with pytest.raises(ValueError):
+        partition_ids(jnp.zeros((1, 2)), "nope", 4, DOMAIN)
