@@ -1,0 +1,15 @@
+"""Transport plane: in-memory bus (tests/local) and Kafka (gated), plus the worker."""
+
+from skyline_tpu.bridge.memory import MemoryBus
+
+__all__ = ["MemoryBus", "SkylineWorker"]
+
+
+def __getattr__(name):
+    # SkylineWorker imports the engine, which imports bridge.wire; resolving
+    # the worker lazily keeps that cycle out of package-import time.
+    if name == "SkylineWorker":
+        from skyline_tpu.bridge.worker import SkylineWorker
+
+        return SkylineWorker
+    raise AttributeError(name)

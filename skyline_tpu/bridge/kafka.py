@@ -1,0 +1,79 @@
+"""Kafka transport (gated): the production bridge onto the reference's topics.
+
+Mirrors the reference's Kafka wiring exactly — bootstrap ``localhost:9092``,
+data topic consumed from earliest, query topic from latest, 10 MB max request
+size on the result producer (FlinkSkyline.java:84-97, 177-183;
+docker-setup/docker-compose.yml:20-21) — so the reference's own Python
+harness (producers, collector) works unchanged against this engine.
+
+``kafka-python`` is not part of the baked image; everything here raises a
+clear error at construction time if it is missing, and the rest of the
+framework (MemoryBus path) never imports it.
+"""
+
+from __future__ import annotations
+
+DEFAULT_BOOTSTRAP = "localhost:9092"
+MAX_REQUEST_SIZE = 10_485_760  # 10 MB, matching FlinkSkyline.java:179
+
+try:  # pragma: no cover - exercised only where kafka-python is installed
+    from kafka import KafkaConsumer as _KafkaConsumer
+    from kafka import KafkaProducer as _KafkaProducer
+
+    HAVE_KAFKA = True
+except ImportError:  # pragma: no cover
+    _KafkaConsumer = None
+    _KafkaProducer = None
+    HAVE_KAFKA = False
+
+
+def _require_kafka():
+    if not HAVE_KAFKA:
+        raise RuntimeError(
+            "kafka-python is not installed; use skyline_tpu.bridge.memory.MemoryBus "
+            "for in-process runs, or install kafka-python for a real broker"
+        )
+
+
+class KafkaBus:
+    """Same produce/consumer surface as MemoryBus, backed by a real broker."""
+
+    def __init__(self, bootstrap: str = DEFAULT_BOOTSTRAP):
+        _require_kafka()
+        self.bootstrap = bootstrap
+        self._producer = _KafkaProducer(
+            bootstrap_servers=bootstrap,
+            value_serializer=lambda s: s.encode("utf-8"),
+            max_request_size=MAX_REQUEST_SIZE,
+        )
+
+    def produce(self, topic: str, message: str) -> None:
+        self._producer.send(topic, message)
+
+    def produce_many(self, topic: str, messages) -> None:
+        for m in messages:
+            self._producer.send(topic, m)
+        self._producer.flush()
+
+    def consumer(self, topic: str, from_beginning: bool = True):
+        _require_kafka()
+        c = _KafkaConsumer(
+            topic,
+            bootstrap_servers=self.bootstrap,
+            auto_offset_reset="earliest" if from_beginning else "latest",
+            value_deserializer=lambda b: b.decode("utf-8"),
+        )
+        return _KafkaConsumerAdapter(c)
+
+
+class _KafkaConsumerAdapter:
+    def __init__(self, consumer):
+        self._consumer = consumer
+        self.topic = next(iter(consumer.subscription()), None)
+
+    def poll(self, max_records: int = 65536) -> list[str]:
+        batches = self._consumer.poll(timeout_ms=100, max_records=max_records)
+        out: list[str] = []
+        for records in batches.values():
+            out.extend(r.value for r in records)
+        return out

@@ -1,0 +1,100 @@
+"""jax_skyline_worker: the bridge between the transport plane and the engine.
+
+The TPU-side counterpart of the reference's Flink job process: consumes the
+data topic (earliest) and query topic (latest), feeds the ``SkylineEngine``,
+and produces one JSON result per completed query on the output topic
+(FlinkSkyline.java job wiring :84-97, :177-183). Works over any bus exposing
+``produce``/``consumer`` (MemoryBus or KafkaBus).
+"""
+
+from __future__ import annotations
+
+import time
+
+from skyline_tpu.bridge.wire import format_result, parse_tuple_lines
+from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
+
+# Reference topic names (FlinkSkyline.java:68-70)
+INPUT_TOPIC = "input-tuples"
+QUERY_TOPIC = "queries"
+OUTPUT_TOPIC = "output-skyline"
+
+
+class SkylineWorker:
+    def __init__(
+        self,
+        bus,
+        config: EngineConfig,
+        input_topic: str = INPUT_TOPIC,
+        query_topic: str = QUERY_TOPIC,
+        output_topic: str = OUTPUT_TOPIC,
+    ):
+        self.bus = bus
+        self.engine = SkylineEngine(config)
+        self.output_topic = output_topic
+        self._data = bus.consumer(input_topic, from_beginning=True)
+        self._queries = bus.consumer(query_topic, from_beginning=False)
+        self.results_emitted = 0
+
+    def step(self, max_records: int = 65536) -> int:
+        """One poll cycle: drain data, drain triggers, emit finished results.
+
+        Returns the number of messages processed (0 == idle).
+        """
+        lines = self._data.poll(max_records)
+        if lines:
+            ids, values, dropped = parse_tuple_lines(lines, self.engine.config.dims)
+            self.engine.dropped += dropped
+            self.engine.process_records(ids, values)
+        triggers = self._queries.poll(max_records)
+        for t in triggers:
+            self.engine.process_trigger(t)
+        for result in self.engine.poll_results():
+            self.bus.produce(self.output_topic, format_result(result))
+            self.results_emitted += 1
+        return len(lines) + len(triggers)
+
+    def run_forever(self, idle_sleep_s: float = 0.01, stop_after_idle_s: float | None = None):
+        """Poll loop; optionally exits after ``stop_after_idle_s`` of silence."""
+        idle_since = None
+        while True:
+            n = self.step()
+            if n == 0:
+                now = time.time()
+                if idle_since is None:
+                    idle_since = now
+                elif stop_after_idle_s is not None and now - idle_since > stop_after_idle_s:
+                    return
+                time.sleep(idle_sleep_s)
+            else:
+                idle_since = None
+
+
+def main(argv=None):
+    """CLI: run the worker against a Kafka broker with reference-style flags
+    (the `flink run` equivalent of README_Ubuntu_Setup.md's job launch)."""
+    import sys
+
+    from skyline_tpu.bridge.kafka import KafkaBus
+    from skyline_tpu.utils.config import parse_job_args
+
+    cfg = parse_job_args(argv)
+    bus = KafkaBus(cfg.bootstrap)
+    worker = SkylineWorker(
+        bus,
+        cfg.engine_config(),
+        input_topic=cfg.input_topic,
+        query_topic=cfg.query_topic,
+        output_topic=cfg.output_topic,
+    )
+    print(
+        f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
+        f"dims={cfg.dims} broker={cfg.bootstrap}",
+        file=sys.stderr,
+    )
+    worker.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

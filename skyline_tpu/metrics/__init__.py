@@ -1,0 +1,5 @@
+"""Metrics collection (result JSON → CSV) and phase tracing."""
+
+from skyline_tpu.metrics.collector import CSV_HEADERS, append_result_row, collect
+
+__all__ = ["CSV_HEADERS", "append_result_row", "collect"]

@@ -1,0 +1,106 @@
+"""Result-JSON → CSV collector.
+
+Parity with python/metrics_collector.py: same 10-column schema (:60-71), one
+row per completed query, per-row flush (:123). The ``Latency(ms)`` column is
+populated for real here because the engine actually emits
+``query_latency_ms`` (the reference computes it at FlinkSkyline.java:588 but
+omits it from the JSON, so the reference's column is always 0 — SURVEY.md
+§3.5).
+
+Usable as a library (``append_result_row``) against any bus, or as a CLI
+(``python -m skyline_tpu.metrics.collector out.csv``) against Kafka or a
+JSON-lines file/stdin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+
+CSV_HEADERS = [
+    "QueryID",
+    "Records",
+    "SkylineSize",
+    "Optimality",
+    "IngestTime(ms)",
+    "LocalTime(ms)",
+    "GlobalTime(ms)",
+    "TotalTime(ms)",
+    "Latency(ms)",
+    "SkylinePoints",
+]
+
+
+def result_to_row(data: dict) -> list:
+    return [
+        data.get("query_id", "N/A"),
+        data.get("record_count", 0),
+        data.get("skyline_size", 0),
+        data.get("optimality", 0.0),
+        data.get("ingestion_time_ms", 0),
+        data.get("local_processing_time_ms", 0),
+        data.get("global_processing_time_ms", 0),
+        data.get("total_processing_time_ms", 0),
+        data.get("query_latency_ms", 0),
+        json.dumps(data.get("skyline_points", [])),
+    ]
+
+
+def append_result_row(path: str, data: dict) -> None:
+    """Append one result to a CSV file, writing the header on first touch."""
+    exists = os.path.isfile(path)
+    with open(path, mode="a", newline="") as f:
+        w = csv.writer(f)
+        if not exists:
+            w.writerow(CSV_HEADERS)
+        w.writerow(result_to_row(data))
+        f.flush()
+
+
+def collect(messages, path: str, echo: bool = True) -> int:
+    """Drain an iterable of result-JSON strings (or dicts) into the CSV."""
+    n = 0
+    for m in messages:
+        data = json.loads(m) if isinstance(m, str) else m
+        append_result_row(path, data)
+        if echo:
+            print(
+                f"[Query {data.get('query_id')}] Records: {data.get('record_count')} "
+                f"| Size: {data.get('skyline_size')} "
+                f"| TotalTime: {data.get('total_processing_time_ms')}ms"
+            )
+        n += 1
+    return n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("output_csv")
+    ap.add_argument("--source", choices=["kafka", "stdin"], default="kafka")
+    ap.add_argument("--topic", default="output-skyline")
+    ap.add_argument("--bootstrap", default="localhost:9092")
+    args = ap.parse_args(argv)
+
+    if args.source == "stdin":
+        collect((ln for ln in sys.stdin if ln.strip()), args.output_csv)
+        return 0
+
+    from skyline_tpu.bridge.kafka import KafkaBus
+
+    consumer = KafkaBus(args.bootstrap).consumer(args.topic, from_beginning=False)
+    print(f"--- Listening on topic '{args.topic}' ---", file=sys.stderr)
+    try:
+        while True:
+            batch = consumer.poll()
+            if batch:
+                collect(batch, args.output_csv)
+    except KeyboardInterrupt:
+        print("\nStopping collector...", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,75 @@
+"""Per-phase tracing: device/host/transfer time accounting.
+
+The reference's tracing is manual nanoTime deltas around BNL work plus the
+aggregator's ingestion/local/global decomposition, surfaced as a product
+feature in the result JSON (SURVEY.md §5). This module generalizes that into
+named phase timers the engine/worker/bench can nest, with the same
+"breakdown is a feature" stance: ``report()`` returns totals suitable for
+logging or embedding in results.
+
+Device timing caveat: JAX dispatch is async; a phase that should count
+device time must close over ``block_until_ready`` (use ``device_phase``) or
+the time lands in whichever phase later forces the sync.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Tracer:
+    def __init__(self):
+        self._total_ns: dict[str, int] = defaultdict(int)
+        self._count: dict[str, int] = defaultdict(int)
+        self._stack: list[str] = []
+
+    @contextmanager
+    def phase(self, name: str):
+        """Accumulate host wall time under ``name`` (exclusive of nothing —
+        nested phases overlap their parents by design, like the reference's
+        ingestion = wall - local arithmetic)."""
+        t0 = time.perf_counter_ns()
+        self._stack.append(name)
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            self._total_ns[name] += time.perf_counter_ns() - t0
+            self._count[name] += 1
+
+    @contextmanager
+    def device_phase(self, name: str, *arrays_to_sync):
+        """Like ``phase`` but blocks on the given jax arrays before closing,
+        so async-dispatched device work is attributed here."""
+        import jax
+
+        t0 = time.perf_counter_ns()
+        self._stack.append(name)
+        try:
+            yield
+        finally:
+            self._stack.pop()
+            if arrays_to_sync:
+                jax.block_until_ready(arrays_to_sync)
+            self._total_ns[name] += time.perf_counter_ns() - t0
+            self._count[name] += 1
+
+    def add_ns(self, name: str, ns: int) -> None:
+        self._total_ns[name] += ns
+        self._count[name] += 1
+
+    def report(self) -> dict[str, dict[str, float]]:
+        return {
+            name: {
+                "total_ms": self._total_ns[name] / 1e6,
+                "count": self._count[name],
+                "mean_ms": self._total_ns[name] / 1e6 / max(1, self._count[name]),
+            }
+            for name in sorted(self._total_ns)
+        }
+
+    def reset(self) -> None:
+        self._total_ns.clear()
+        self._count.clear()

@@ -1,0 +1,85 @@
+"""Native fast-path loader (ctypes): builds fastcsv.so on first use.
+
+``parse_tuples_native(text, dims)`` parses a newline-joined batch of
+data-plane lines into (ids, values, dropped) ~20-50x faster than the Python
+line loop. Returns None from ``get_lib()`` (and the wire module falls back to
+Python parsing) if no compiler is available or the build fails — the
+framework never hard-requires the native component.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "fastcsv.cpp")
+_SO = os.path.join(_HERE, "fastcsv.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib():
+    """The loaded ctypes library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.sky_parse_tuples.restype = ctypes.c_int64
+        lib.sky_parse_tuples.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+    return _lib
+
+
+def parse_tuples_native(text: bytes, dims: int, max_rows: int):
+    """Parse a newline-separated byte buffer. Returns (ids, values, dropped)
+    or None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    ids = np.empty(max_rows, dtype=np.int64)
+    values = np.empty((max_rows, dims), dtype=np.float32)
+    dropped = ctypes.c_int64(0)
+    n = lib.sky_parse_tuples(
+        text,
+        len(text),
+        dims,
+        max_rows,
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(dropped),
+    )
+    return ids[:n], values[:n], int(dropped.value)

@@ -1,0 +1,128 @@
+// Fast data-plane CSV parser: "id,v1,...,vd" lines -> (ids, values) arrays.
+//
+// The TPU-side ingest hot path. The reference's wire format is CSV strings on
+// Kafka (unified_producer.py:174, parsed tuple-at-a-time by
+// ServiceTuple.fromString, ServiceTuple.java:89-104); at stream rates the
+// reference attributes ~80% of total processing time to ingest (pdf §5.5).
+// This parser processes a whole poll batch as one contiguous byte buffer with
+// no allocation, writing straight into caller-provided numpy buffers.
+//
+// Semantics parity with skyline_tpu.bridge.wire.parse_tuple_lines (which is
+// also the fallback when this library isn't built): a line is dropped — not
+// an error — when it has the wrong field count, a non-integer id, a
+// non-numeric value, or any non-finite value (NaN/inf must never enter
+// windows; +inf is reserved for padding).
+//
+// Build: see skyline_tpu/native/__init__.py (g++ -O3 -shared -fPIC).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// Parse an integer id; returns false on malformed or int64 overflow (an
+// out-of-range id is a dropped line, matching the Python fallback).
+bool parse_id(const char*& p, const char* end, int64_t& out) {
+    bool neg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+        neg = (*p == '-');
+        ++p;
+    }
+    if (p >= end || *p < '0' || *p > '9') return false;
+    uint64_t v = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+        if (v > (UINT64_MAX - 9) / 10) return false;
+        v = v * 10 + static_cast<uint64_t>(*p - '0');
+        ++p;
+    }
+    const uint64_t limit =
+        neg ? (static_cast<uint64_t>(INT64_MAX) + 1) : static_cast<uint64_t>(INT64_MAX);
+    if (v > limit) return false;
+    out = neg ? -static_cast<int64_t>(v - 1) - 1 : static_cast<int64_t>(v);
+    return true;
+}
+
+// Fast float parse for the common integer-valued case (the generators stream
+// integers); falls back to strtof for general decimals/exponents.
+bool parse_value(const char*& p, const char* end, float& out) {
+    const char* start = p;
+    bool neg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+        neg = (*p == '-');
+        ++p;
+    }
+    int64_t ip = 0;
+    int digits = 0;
+    while (p < end && *p >= '0' && *p <= '9' && digits < 18) {
+        ip = ip * 10 + (*p - '0');
+        ++p;
+        ++digits;
+    }
+    if (digits > 0 && (p == end || *p == ',' || *p == '\n' || *p == '\r')) {
+        out = static_cast<float>(neg ? -ip : ip);
+        return true;
+    }
+    // general path (decimals, exponents, or >18 digits)
+    char tmp[64];
+    size_t n = 0;
+    const char* q = start;
+    while (q < end && *q != ',' && *q != '\n' && *q != '\r' && n < sizeof(tmp) - 1)
+        tmp[n++] = *q++;
+    if (n == 0) return false;
+    tmp[n] = '\0';
+    char* parsed_end = nullptr;
+    float v = strtof(tmp, &parsed_end);
+    if (parsed_end != tmp + n) return false;
+    if (!std::isfinite(v)) return false;
+    p = q;
+    out = v;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the number of parsed rows (<= max_rows); *dropped counts malformed
+// lines. Stops early (remaining lines dropped-silently excluded from both
+// counts) only if max_rows is hit — callers size max_rows to the line count.
+int64_t sky_parse_tuples(const char* buf, int64_t len, int32_t dims,
+                         int64_t max_rows, int64_t* ids, float* values,
+                         int64_t* dropped) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t rows = 0;
+    int64_t bad = 0;
+    while (p < end && rows < max_rows) {
+        const char* line_end = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        if (line_end == nullptr) line_end = end;
+        const char* q = p;
+        const char* qe = line_end;
+        if (qe > q && qe[-1] == '\r') --qe;  // tolerate CRLF
+
+        bool ok = (qe > q);
+        int64_t id = 0;
+        if (ok) ok = parse_id(q, qe, id);
+        float* row = values + rows * dims;
+        for (int32_t k = 0; ok && k < dims; ++k) {
+            ok = (q < qe && *q == ',');
+            if (ok) ++q;
+            if (ok) ok = parse_value(q, qe, row[k]);
+        }
+        if (ok && q != qe) ok = false;  // trailing junk / too many fields
+        if (ok) {
+            ids[rows] = id;
+            ++rows;
+        } else if (line_end > p) {
+            ++bad;
+        }
+        p = line_end + 1;
+    }
+    *dropped = bad;
+    return rows;
+}
+
+}  // extern "C"

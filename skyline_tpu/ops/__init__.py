@@ -11,6 +11,7 @@ from skyline_tpu.ops.dominance import (
 )
 from skyline_tpu.ops.block_skyline import (
     skyline_mask_blocked,
+    skyline_mask_scan,
     skyline_large,
 )
 
@@ -23,5 +24,6 @@ __all__ = [
     "skyline_np",
     "pad_window",
     "skyline_mask_blocked",
+    "skyline_mask_scan",
     "skyline_large",
 ]

@@ -1,0 +1,65 @@
+"""Fully-jitted windowed query pipeline over a mesh (partition → local → merge).
+
+The one-call on-device equivalent of the engine's per-window work, used by the
+flagship entry point and the multi-chip dry run: compute partition ids with
+the configured MR-* strategy, group rows by their target device with one
+argsort (the keyBy shuffle, FlinkSkyline.java:138), equal-split the grouped
+rows across the mesh, run the sharded two-phase skyline, and report the
+global mask plus per-phase counts.
+
+Shard-size note: real partitions are data-dependent in size, so the SPMD
+split assigns each device an equal contiguous slice of the partition-sorted
+order. Rows of one logical partition can straddle two devices at slice
+boundaries; the global skyline is provably invariant to placement (the merge
+law, SURVEY.md §4), so this only marginally affects local-phase pruning
+rates, not results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skyline_tpu.parallel.mesh import AXIS, build_two_phase
+from skyline_tpu.parallel.partitioners import partition_ids
+
+
+def build_window_pipeline(
+    mesh: Mesh,
+    *,
+    algo: str = "mr-angle",
+    num_partitions: int | None = None,
+    domain_max: float = 1000.0,
+    axis: str = AXIS,
+    local_block: int = 2048,
+    cross_block: int = 8192,
+):
+    """Returns jitted ``step(x, valid) ->
+    (global_keep, local_count, global_count, order)``.
+
+    x: (N, d) window (replicated input), N divisible by the mesh size.
+    ``global_keep`` is aligned to the *partition-sorted* row order given by
+    ``order`` (``x[order]`` are the sorted rows); invert with
+    ``argsort(order)`` to map the mask back to input order.
+    """
+    n_dev = int(mesh.devices.size)
+    if num_partitions is None:
+        num_partitions = 2 * n_dev  # reference's 2x over-partitioning
+
+    two_phase = build_two_phase(
+        mesh, axis=axis, local_block=local_block, cross_block=cross_block
+    )
+    x_sharding = NamedSharding(mesh, P(axis))
+
+    @jax.jit
+    def step(x, valid):
+        pids = partition_ids(x, algo, num_partitions, domain_max)
+        dev = pids % n_dev  # logical partition -> device, round-robin
+        order = jnp.argsort(jnp.where(valid, dev, n_dev), stable=True)
+        xs = jax.lax.with_sharding_constraint(x[order], x_sharding)
+        vs = jax.lax.with_sharding_constraint(valid[order], x_sharding)
+        local_keep, global_keep = two_phase(xs, vs)
+        return global_keep, jnp.sum(local_keep), jnp.sum(global_keep), order
+
+    return step

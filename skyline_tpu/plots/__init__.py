@@ -1,0 +1,5 @@
+"""Figure tools — parity with the reference's four graph_*.py scripts.
+
+All read the collector CSV schema (skyline_tpu.metrics.collector.CSV_HEADERS)
+and write PNGs; matplotlib's Agg backend is forced so they run headless.
+"""

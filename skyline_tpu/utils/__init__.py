@@ -1,0 +1,15 @@
+"""Config, checkpointing, capacity bucketing, and shared helpers."""
+
+from skyline_tpu.utils.buckets import next_pow2
+
+__all__ = ["JobConfig", "parse_job_args", "next_pow2"]
+
+
+def __getattr__(name):
+    # config imports the engine (which imports ops, which imports
+    # utils.buckets); resolving lazily keeps that cycle out of import time.
+    if name in ("JobConfig", "parse_job_args"):
+        from skyline_tpu.utils import config
+
+        return getattr(config, name)
+    raise AttributeError(name)

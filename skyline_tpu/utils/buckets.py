@@ -1,0 +1,14 @@
+"""Shared capacity-bucketing helper.
+
+All dynamically-sized buffers (running skylines, merge unions, checkpoint
+restores) round capacities to powers of two so XLA compiles a bounded number
+of shape variants (~log2(N) per call site).
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int, min_cap: int = 256) -> int:
+    """Smallest power of two >= max(n, 1), floored at ``min_cap`` (itself a
+    power of two)."""
+    return 1 << max(min_cap.bit_length() - 1, (max(n, 1) - 1).bit_length())

@@ -1,0 +1,110 @@
+"""Job configuration — one validated config object for the whole system.
+
+The reference's flag surface is Flink ``ParameterTool.fromArgs`` with inline
+defaults (``--parallelism 4 --algo mr-angle --input-topic input-tuples
+--query-topic queries --output-topic output-skyline --domain 1000.0
+--dims 2``, FlinkSkyline.java:62-72) plus ``localhost:9092`` hardcoded in
+five places and zero validation (SURVEY.md §5). Here the same flags (same
+names, same defaults) parse into one dataclass with validation, env-var
+overrides (``SKYLINE_<FLAG>``), and the broker address as a real setting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+
+from skyline_tpu.stream.engine import EngineConfig
+
+_ALGOS = ("mr-dim", "mr-grid", "mr-angle")
+
+
+@dataclasses.dataclass
+class JobConfig:
+    parallelism: int = 4
+    algo: str = "mr-angle"
+    input_topic: str = "input-tuples"
+    query_topic: str = "queries"
+    output_topic: str = "output-skyline"
+    domain: float = 1000.0
+    dims: int = 2
+    bootstrap: str = "localhost:9092"
+    buffer_size: int = 4096
+    emit_skyline_points: bool = False
+
+    def __post_init__(self):
+        if self.parallelism < 1:
+            raise ValueError(f"parallelism must be >= 1, got {self.parallelism}")
+        if self.algo not in _ALGOS:
+            raise ValueError(f"algo must be one of {_ALGOS}, got {self.algo!r}")
+        if self.dims < 1:
+            raise ValueError(f"dims must be >= 1, got {self.dims}")
+        if self.domain <= 0:
+            raise ValueError(f"domain must be > 0, got {self.domain}")
+        if self.buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {self.buffer_size}")
+
+    def engine_config(self) -> EngineConfig:
+        return EngineConfig(
+            parallelism=self.parallelism,
+            algo=self.algo,
+            domain_max=self.domain,
+            dims=self.dims,
+            buffer_size=self.buffer_size,
+            emit_skyline_points=self.emit_skyline_points,
+        )
+
+
+def parse_job_args(argv=None) -> JobConfig:
+    """Parse reference-style flags; SKYLINE_* env vars override defaults and
+    CLI flags override both."""
+    defaults = JobConfig()
+    ap = argparse.ArgumentParser(description="tpu-skyline job flags")
+    ap.add_argument("--parallelism", type=int,
+                    default=_env_int("PARALLELISM", defaults.parallelism))
+    ap.add_argument("--algo", default=os.environ.get("SKYLINE_ALGO", defaults.algo))
+    ap.add_argument("--input-topic",
+                    default=os.environ.get("SKYLINE_INPUT_TOPIC", defaults.input_topic))
+    ap.add_argument("--query-topic",
+                    default=os.environ.get("SKYLINE_QUERY_TOPIC", defaults.query_topic))
+    ap.add_argument("--output-topic",
+                    default=os.environ.get("SKYLINE_OUTPUT_TOPIC", defaults.output_topic))
+    ap.add_argument("--domain", type=float, default=_env_float("DOMAIN", defaults.domain))
+    ap.add_argument("--dims", type=int, default=_env_int("DIMS", defaults.dims))
+    ap.add_argument("--bootstrap",
+                    default=os.environ.get("SKYLINE_BOOTSTRAP", defaults.bootstrap))
+    ap.add_argument("--buffer-size", type=int,
+                    default=_env_int("BUFFER_SIZE", defaults.buffer_size))
+    ap.add_argument("--emit-skyline-points", action="store_true",
+                    default=_env_bool("EMIT_SKYLINE_POINTS"))
+    a = ap.parse_args(argv)
+    return JobConfig(
+        parallelism=a.parallelism,
+        algo=a.algo,
+        input_topic=a.input_topic,
+        query_topic=a.query_topic,
+        output_topic=a.output_topic,
+        domain=a.domain,
+        dims=a.dims,
+        bootstrap=a.bootstrap,
+        buffer_size=a.buffer_size,
+        emit_skyline_points=a.emit_skyline_points,
+    )
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(f"SKYLINE_{name}")
+    return int(v) if v else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(f"SKYLINE_{name}")
+    return float(v) if v else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(f"SKYLINE_{name}")
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")

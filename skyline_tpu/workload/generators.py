@@ -1,0 +1,95 @@
+"""Vectorized synthetic stream generators — the three reference distributions.
+
+Formula parity with python/unified_producer.py:50-123, vectorized over whole
+batches with numpy instead of per-tuple faker calls:
+
+- ``uniform``: independent integers in [d_min, d_max] (:50-51)
+- ``correlated``: per-point base in [d_min, d_max] plus per-dimension noise in
+  ±(1-rho)(d_max-d_min), truncated to int and clamped (:58-73); points hug the
+  diagonal, easiest to prune
+- ``anti_correlated``: a random positive direction vector scaled so its sum
+  lands in a band around the hypercube-center sum, with the reference's
+  dimension-dependent band thickness heuristic (2D: 0.0005, 3D: 0.05,
+  4D: 0.9, else d*0.5 — :92-102), truncated and clamped; points hug the
+  anti-diagonal, the documented worst case (pdf §5.6)
+
+All generators return int-valued float32 arrays (the reference streams
+integers as CSV; values stay exactly representable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Trigger injection interval (unified_producer.py:25)
+QUERY_THRESHOLD = 1_000_000
+
+
+def _epsilon(dimensions: int) -> float:
+    # unified_producer.py:92-102
+    if dimensions == 2:
+        return 0.0005
+    if dimensions == 3:
+        return 0.05
+    if dimensions == 4:
+        return 0.9
+    return dimensions * 0.005 * 100
+
+
+def uniform(rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float):
+    vals = rng.integers(int(d_min), int(d_max) + 1, size=(n, dims))
+    return vals.astype(np.float32)
+
+
+def correlated(
+    rng: np.random.Generator,
+    n: int,
+    dims: int,
+    d_min: float,
+    d_max: float,
+    rho: float = 0.9,
+):
+    base = rng.uniform(d_min, d_max, size=(n, 1))
+    spread = (1.0 - rho) * (d_max - d_min)
+    noise = rng.uniform(-spread, spread, size=(n, dims))
+    vals = np.trunc(base + noise)  # int(val) truncates toward zero for v >= 0
+    return np.clip(vals, d_min, d_max).astype(np.float32)
+
+
+def anti_correlated(
+    rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float
+):
+    eps = _epsilon(dims)
+    vals = rng.random(size=(n, dims))
+    total = vals.sum(axis=1, keepdims=True)
+    total = np.where(total == 0, 1.0, total)
+    mean = (d_min + d_max) / 2.0 * dims
+    slack = eps * (d_max - d_min) * dims
+    target = rng.uniform(mean - slack, mean + slack, size=(n, 1))
+    scaled = vals * (target / total)
+    return np.clip(np.trunc(scaled), d_min, d_max).astype(np.float32)
+
+
+GENERATORS = {
+    "uniform": uniform,
+    "correlated": correlated,
+    "anti_correlated": anti_correlated,
+}
+
+
+def generate(
+    method: str,
+    rng: np.random.Generator,
+    n: int,
+    dims: int,
+    d_min: float,
+    d_max: float,
+):
+    """Dispatch by distribution name (the GenMethod enum, unified_producer.py:31-42)."""
+    try:
+        fn = GENERATORS[method.lower().replace("-", "_")]
+    except KeyError:
+        raise ValueError(
+            f"unknown distribution {method!r}; expected one of {sorted(GENERATORS)}"
+        ) from None
+    return fn(rng, n, dims, d_min, d_max)

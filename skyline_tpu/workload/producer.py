@@ -1,0 +1,90 @@
+"""Unified producer CLI — data stream + periodic query triggers.
+
+Parity with python/unified_producer.py: positional args
+``<topic> <distribution> <dims> <d_min> <d_max> [query-topic]``
+(:137-142), CSV tuple lines ``"id,v1,...,vd"`` (:174), a trigger
+``"queryId,recordId"`` every QUERY_THRESHOLD records (:180-188), and a
+progress print every 100k (:191-192). Differences: batched generation
+(vectorized numpy instead of per-tuple faker), an optional ``--count`` bound
+instead of only an infinite loop, and ``--sink stdout`` for broker-less runs
+(kafka-python is optional in this environment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from skyline_tpu.bridge.wire import format_trigger
+from skyline_tpu.workload.generators import QUERY_THRESHOLD, generate
+
+
+def _build_sink(args):
+    if args.sink == "stdout":
+        def send(topic, lines):
+            out = sys.stdout
+            for ln in lines:
+                out.write(f"{topic}\t{ln}\n")
+        return send
+    from skyline_tpu.bridge.kafka import KafkaBus
+
+    bus = KafkaBus(args.bootstrap)
+
+    def send(topic, lines):
+        bus.produce_many(topic, lines)
+
+    return send
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("topic", nargs="?", default="input-tuples")
+    ap.add_argument("distribution", nargs="?", default="uniform")
+    ap.add_argument("dims", nargs="?", type=int, default=2)
+    ap.add_argument("d_min", nargs="?", type=float, default=0.0)
+    ap.add_argument("d_max", nargs="?", type=float, default=1000.0)
+    ap.add_argument("query_topic", nargs="?", default="queries")
+    ap.add_argument("--count", type=int, default=0, help="stop after N records (0 = infinite)")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--query-threshold", type=int, default=QUERY_THRESHOLD)
+    ap.add_argument("--sink", choices=["kafka", "stdout"], default="kafka")
+    ap.add_argument("--bootstrap", default="localhost:9092")
+    args = ap.parse_args(argv)
+
+    send = _build_sink(args)
+    rng = np.random.default_rng(args.seed)
+    record_id = 0
+    query_id = 0
+    next_trigger = args.query_threshold
+    next_progress = 100_000
+
+    while args.count == 0 or record_id < args.count:
+        n = args.batch if args.count == 0 else min(args.batch, args.count - record_id)
+        vals = generate(args.distribution, rng, n, args.dims, args.d_min, args.d_max)
+        ids = np.arange(record_id, record_id + n, dtype=np.int64)
+        # integer-valued floats print without trailing .0 via int cast
+        lines = [
+            str(i) + "," + ",".join(str(int(v)) for v in row)
+            for i, row in zip(ids, vals)
+        ]
+        send(args.topic, lines)
+        record_id += n
+        while record_id >= next_trigger:
+            # barrier = the threshold-crossing id, NOT the batch-end id: the
+            # reference fires per-record at the threshold
+            # (unified_producer.py:180-188); stamping the batch tail would
+            # set a barrier no partition can clear until ids pass it
+            send(args.query_topic, [format_trigger(query_id, next_trigger - 1)])
+            query_id += 1
+            next_trigger += args.query_threshold
+        if record_id >= next_progress:
+            print(f"produced {record_id} records", file=sys.stderr)
+            next_progress += 100_000
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

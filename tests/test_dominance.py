@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 from skyline_tpu.ops import (
+    skyline_mask_scan,
     PAD_VALUE,
     dominance_mask,
     dominates,
@@ -133,3 +134,21 @@ def test_compact_packs_and_pads():
     np.testing.assert_allclose(np.asarray(vals)[:2], [[2, 2], [4, 4]])
     assert list(np.asarray(valid)) == [True, True, False]
     assert np.isinf(np.asarray(vals)[2]).all()
+
+
+@pytest.mark.parametrize("n,chunk", [(100, 32), (1000, 0), (5000, 512)])
+def test_skyline_mask_scan_matches_dense(rng, n, chunk):
+    for d in (2, 6):
+        x = rng.uniform(0, 1000, size=(n, d)).astype(np.float32)
+        dense = np.asarray(skyline_mask(jnp.asarray(x)))
+        scan = np.asarray(skyline_mask_scan(jnp.asarray(x), chunk=chunk))
+        np.testing.assert_array_equal(dense, scan)
+
+
+def test_skyline_mask_scan_with_padding(rng):
+    from skyline_tpu.ops import skyline_mask_scan as sms
+    x = rng.uniform(0, 1000, size=(77, 3)).astype(np.float32)
+    vals, valid = pad_window(x, 128)
+    keep = np.asarray(sms(vals, valid, chunk=32))
+    assert not keep[77:].any()
+    assert_same_set(np.asarray(vals)[keep], skyline_np(x))

@@ -1,0 +1,105 @@
+"""Native CSV parser: build, parity with the Python parser, speed sanity."""
+
+import numpy as np
+import pytest
+
+from skyline_tpu import native
+from skyline_tpu.bridge.wire import format_tuple_line
+
+
+def _python_parse(lines, dims):
+    # the semantics-defining fallback, bypassing the native fast path
+    import skyline_tpu.bridge.wire as wire
+
+    ids, rows, dropped = [], [], 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split(",")
+        if len(parts) != dims + 1:
+            dropped += 1
+            continue
+        try:
+            rid = int(parts[0])
+            vals = [float(p) for p in parts[1:]]
+        except ValueError:
+            dropped += 1
+            continue
+        if not all(np.isfinite(v) for v in vals):
+            dropped += 1
+            continue
+        ids.append(rid)
+        rows.append(vals)
+    return (
+        np.asarray(ids, dtype=np.int64),
+        np.asarray(rows, dtype=np.float32).reshape(len(rows), dims),
+        dropped,
+    )
+
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native build unavailable"
+)
+
+
+@needs_native
+def test_native_matches_python_on_clean_lines(rng):
+    lines = [
+        format_tuple_line(i, row)
+        for i, row in enumerate(rng.uniform(0, 10000, size=(500, 4)))
+    ]
+    got = native.parse_tuples_native(("\n".join(lines)).encode(), 4, len(lines))
+    ids, vals, dropped = got
+    pids, pvals, pdropped = _python_parse(lines, 4)
+    assert dropped == pdropped == 0
+    np.testing.assert_array_equal(ids, pids)
+    np.testing.assert_allclose(vals, pvals, rtol=1e-6)
+
+
+@needs_native
+def test_native_matches_python_on_dirty_lines():
+    lines = [
+        "1,10,20",
+        "garbage",
+        "2,10",            # wrong arity
+        "3,x,20",          # non-numeric
+        "4,nan,20",        # non-finite
+        "5,inf,20",
+        "6,30,40",
+        "7,30,40,50",      # too many fields
+        "-8,1.5,2.75",     # negative id, decimals
+        "9,1e2,2.5e-1",    # exponents
+        "",                # blank (skipped entirely by both)
+    ]
+    n_ids, n_vals, n_drop = native.parse_tuples_native(
+        ("\n".join(lines)).encode(), 2, len(lines)
+    )
+    p_ids, p_vals, p_drop = _python_parse(lines, 2)
+    np.testing.assert_array_equal(n_ids, p_ids)
+    np.testing.assert_allclose(n_vals, p_vals, rtol=1e-6)
+    assert n_drop == p_drop
+
+
+@needs_native
+def test_native_integer_fast_path_exact():
+    lines = ["0,12345,67890", "1,0,9999999"]
+    ids, vals, _ = native.parse_tuples_native(("\n".join(lines)).encode(), 2, 2)
+    np.testing.assert_array_equal(vals, [[12345.0, 67890.0], [0.0, 9999999.0]])
+
+
+@needs_native
+def test_native_crlf_tolerated():
+    ids, vals, drop = native.parse_tuples_native(b"1,2,3\r\n2,4,5\r\n", 2, 2)
+    assert list(ids) == [1, 2]
+    assert drop == 0
+
+
+def test_wire_uses_native_when_available(rng):
+    # end-to-end through the public wire function (whichever path is active)
+    from skyline_tpu.bridge.wire import parse_tuple_lines
+
+    lines = [format_tuple_line(i, r) for i, r in enumerate(rng.uniform(0, 100, size=(50, 3)))]
+    lines.insert(10, "bogus,line")
+    ids, vals, dropped = parse_tuple_lines(lines, 3)
+    assert len(ids) == 50 and dropped == 1

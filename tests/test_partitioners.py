@@ -87,3 +87,17 @@ def test_mr_angle_matches_scalar_formula(rng):
 def test_partition_ids_rejects_unknown():
     with pytest.raises(ValueError):
         partition_ids(jnp.zeros((1, 2)), "nope", 4, DOMAIN)
+
+
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-grid", "mr-angle"])
+@pytest.mark.parametrize("d", [2, 5, 8])
+def test_np_twin_matches_jnp(rng, algo, d):
+    # the engine routes on the numpy twin; the device pipeline uses jnp —
+    # they must agree exactly or local pruning quality silently diverges
+    from skyline_tpu.parallel.partitioners import partition_ids_np
+
+    x = rng.uniform(0, DOMAIN, size=(3000, d)).astype(np.float32)
+    for P in (2, 8, 16):
+        a = np.asarray(partition_ids(jnp.asarray(x), algo, P, DOMAIN))
+        b = partition_ids_np(x, algo, P, DOMAIN)
+        np.testing.assert_array_equal(a, b)

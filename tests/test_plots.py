@@ -1,0 +1,57 @@
+"""Plot tools render PNGs from collector CSVs (headless)."""
+
+import numpy as np
+
+from skyline_tpu.bridge import MemoryBus, SkylineWorker
+from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+from skyline_tpu.metrics.collector import collect
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.workload.generators import anti_correlated
+
+
+def _make_csv(rng, tmp_path, name="run.csv", n=800):
+    bus = MemoryBus()
+    cfg = EngineConfig(parallelism=2, algo="mr-angle", dims=2,
+                       domain_max=10000.0, buffer_size=256,
+                       emit_skyline_points=True)
+    worker = SkylineWorker(bus, cfg)
+    x = anti_correlated(rng, n, 2, 0, 10000)
+    bus.produce_many("input-tuples",
+                     [format_tuple_line(i, r) for i, r in enumerate(x)])
+    bus.produce("queries", format_trigger(0, 0))
+    while worker.step() > 0:
+        pass
+    path = str(tmp_path / name)
+    collect(bus.consumer("output-skyline").poll(), path, echo=False)
+    return path
+
+
+def test_skyline_2d_plot(rng, tmp_path):
+    from skyline_tpu.plots.skyline_2d import plot_skyline
+
+    csv_path = _make_csv(rng, tmp_path)
+    out = plot_skyline(csv_path, out=str(tmp_path / "sky.png"))
+    assert (tmp_path / "sky.png").stat().st_size > 0
+    assert out.endswith("sky.png")
+
+
+def test_performance_dashboard(rng, tmp_path):
+    from skyline_tpu.plots.performance import plot_performance
+
+    a = _make_csv(rng, tmp_path, "a.csv")
+    b = _make_csv(rng, tmp_path, "b.csv", n=600)
+    out = plot_performance({"MR-Angle": a, "MR-Grid": b},
+                           out=str(tmp_path / "perf.png"))
+    assert (tmp_path / "perf.png").stat().st_size > 0
+
+
+def test_by_dimension_and_paper_figures(rng, tmp_path):
+    from skyline_tpu.plots.by_dimension import plot_by_dimension
+    from skyline_tpu.plots.paper_figures import plot_paper_figures
+
+    a = _make_csv(rng, tmp_path, "d2.csv")
+    out = plot_by_dimension({2: {"MR-Angle": a}}, out=str(tmp_path / "bydim.png"))
+    assert (tmp_path / "bydim.png").stat().st_size > 0
+    t, o = plot_paper_figures(prefix=str(tmp_path) + "/")
+    assert (tmp_path / "figure_5_replication.png").stat().st_size > 0
+    assert (tmp_path / "figure_7_replication.png").stat().st_size > 0

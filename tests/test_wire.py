@@ -1,0 +1,77 @@
+"""Wire-format tests: parsing parity with the reference payloads."""
+
+import json
+
+import numpy as np
+
+from skyline_tpu.bridge.wire import (
+    format_result,
+    format_trigger,
+    format_tuple_line,
+    parse_trigger,
+    parse_tuple_lines,
+)
+
+
+def test_parse_tuple_lines_roundtrip():
+    lines = [format_tuple_line(i, [i * 1.0, i * 2.0]) for i in range(5)]
+    ids, vals, dropped = parse_tuple_lines(lines, dims=2)
+    assert dropped == 0
+    np.testing.assert_array_equal(ids, np.arange(5))
+    np.testing.assert_allclose(vals[:, 1], np.arange(5) * 2.0)
+
+
+def test_parse_tuple_lines_drops_malformed():
+    # mirrors ServiceTuple.fromString null-filter (ServiceTuple.java:89-104)
+    lines = [
+        "1,10,20",
+        "garbage",
+        "2,10",          # wrong arity
+        "3,x,20",        # non-numeric
+        "4,nan,20",      # non-finite must not enter windows
+        "5,inf,20",
+        "6,30,40",
+    ]
+    ids, vals, dropped = parse_tuple_lines(lines, dims=2)
+    assert list(ids) == [1, 6]
+    assert dropped == 5
+
+
+def test_parse_trigger_semantics():
+    assert parse_trigger("7,1000000") == ("7", 1000000)
+    # count-less payload -> required 0 -> immediate (query_trigger.py:21-26)
+    assert parse_trigger("3") == ("3", 0)
+    assert parse_trigger("3,notanum") == ("3", 0)
+    assert format_trigger(7, 99) == "7,99"
+
+
+def test_format_result_field_order_and_rounding():
+    res = {
+        "query_id": "0",
+        "record_count": 1000,
+        "skyline_size": 42,
+        "optimality": 0.123456,
+        "ingestion_time_ms": 1,
+        "local_processing_time_ms": 2,
+        "global_processing_time_ms": 3,
+        "total_processing_time_ms": 6,
+        "query_latency_ms": 7,
+    }
+    s = format_result(res)
+    parsed = json.loads(s)
+    assert parsed["optimality"] == 0.1235  # reference renders %.4f
+    assert list(parsed.keys())[:4] == [
+        "query_id",
+        "record_count",
+        "skyline_size",
+        "optimality",
+    ]
+    assert parsed["query_latency_ms"] == 7  # emitted (unlike the reference)
+
+
+def test_parse_tuple_lines_drops_out_of_range_id():
+    # an id beyond int64 must be a dropped line, not an OverflowError
+    lines = ["99999999999999999999999,1,2", "1,3,4"]
+    ids, vals, dropped = parse_tuple_lines(lines, dims=2)
+    assert list(ids) == [1]
+    assert dropped == 1

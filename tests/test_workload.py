@@ -1,0 +1,51 @@
+"""Generator tests: ranges, shapes, distribution-shape sanity (pdf §5.1)."""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.ops import skyline_np
+from skyline_tpu.workload import anti_correlated, correlated, generate, uniform
+
+
+@pytest.mark.parametrize("method", ["uniform", "correlated", "anti_correlated"])
+@pytest.mark.parametrize("dims", [2, 4, 8])
+def test_ranges_and_dtype(rng, method, dims):
+    x = generate(method, rng, 2000, dims, 0, 10000)
+    assert x.shape == (2000, dims)
+    assert x.dtype == np.float32
+    assert (x >= 0).all() and (x <= 10000).all()
+    np.testing.assert_array_equal(x, np.trunc(x))  # integer-valued
+
+
+def test_generate_aliases_and_unknown(rng):
+    generate("anti-correlated", rng, 10, 2, 0, 100)  # dash alias
+    with pytest.raises(ValueError):
+        generate("zipf", rng, 10, 2, 0, 100)
+
+
+def test_distribution_shapes(rng):
+    # Skyline-size ordering at 2D/200k/domain-10k per the reference's sanity
+    # check (pdf §5.1: anti-corr 2961 >> correlated 1716 (all dupes) >> uniform 8).
+    n = 50_000
+    su = skyline_np(uniform(rng, n, 2, 0, 10000)).shape[0]
+    sc_pts = skyline_np(correlated(rng, n, 2, 0, 10000))
+    sa = skyline_np(anti_correlated(rng, n, 2, 0, 10000)).shape[0]
+    assert su < 50
+    assert sa > 500
+    # correlated: the skyline collapses to duplicates of a near-origin point
+    assert np.unique(sc_pts, axis=0).shape[0] < 25
+
+
+def test_correlated_hugs_diagonal(rng):
+    x = correlated(rng, 5000, 3, 0, 10000, rho=0.9)
+    spread = x.max(axis=1) - x.min(axis=1)
+    # noise band is ±(1-rho)*range = ±1000 -> within-point spread <= 2000
+    assert (spread <= 2000).all()
+
+
+def test_anti_correlated_hugs_antidiagonal(rng):
+    x = anti_correlated(rng, 5000, 2, 0, 10000)
+    sums = x.sum(axis=1)
+    # target sum band: mean=10000, slack=0.0005*10000*2=10 (plus trunc/clip)
+    inside = np.abs(sums - 10000) < 50
+    assert inside.mean() > 0.95
