@@ -1,0 +1,115 @@
+"""Closed-loop benchmark runner for the BASELINE.json configs.
+
+Runs each of the five scored configurations end-to-end through the streaming
+engine (and the sliding-window processor for config #4), printing one JSON
+line per config and writing a collector-schema CSV per config under
+``--outdir`` so the plot tools work on the results directly.
+
+Sizes default to a quick pass (``--scale 1`` = full BASELINE sizes; the
+default ``--scale 0.1`` runs 10x smaller for smoke runs).
+
+Usage: python benchmarks/run_configs.py [--scale 0.1] [--outdir bench_out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from skyline_tpu.metrics.collector import append_result_row
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.stream.sliding import SlidingSkyline
+from skyline_tpu.workload.generators import generate
+
+CONFIGS = [
+    # (name, distribution, dims, algo, window_n at scale 1)
+    ("2d_correlated_grid_tumbling", "correlated", 2, "mr-grid", 1_000_000),
+    ("4d_uniform_dim", "uniform", 4, "mr-dim", 1_000_000),
+    ("8d_uniform_dim", "uniform", 8, "mr-dim", 1_000_000),
+    ("8d_anticorrelated_angle", "anti_correlated", 8, "mr-angle", 1_000_000),
+    ("qos_4d_10m", "qos", 4, "mr-angle", 10_000_000),
+]
+SLIDING_CONFIG = ("sliding_4d_anticorrelated", "anti_correlated", 4, 200_000, 50_000)
+
+
+def run_tumbling(name, dist, dims, algo, n, outdir):
+    rng = np.random.default_rng(0)
+    cfg = EngineConfig(parallelism=4, algo=algo, dims=dims, domain_max=10000.0,
+                       buffer_size=4096)
+    eng = SkylineEngine(cfg)
+    x = generate(dist, rng, n, dims, 0, 10000)
+    ids = np.arange(n, dtype=np.int64)
+    t0 = time.perf_counter()
+    for i in range(0, n, 65536):
+        eng.process_records(ids[i : i + 65536], x[i : i + 65536])
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    dt = time.perf_counter() - t0
+    append_result_row(os.path.join(outdir, f"{name}.csv"),
+                      {**r, "record_count": n})
+    return {
+        "config": name,
+        "n": n,
+        "dims": dims,
+        "algo": algo,
+        "tuples_per_sec": round(n / dt, 1),
+        "window_s": round(dt, 2),
+        "skyline_size": r["skyline_size"],
+        "optimality": r["optimality"],
+    }
+
+
+def run_sliding(name, dist, dims, window, slide, outdir):
+    rng = np.random.default_rng(0)
+    sw = SlidingSkyline(window, slide, dims)
+    n = window * 4  # several full-overlap slides
+    x = generate(dist, rng, n, dims, 0, 10000)
+    t0 = time.perf_counter()
+    results = []
+    for i in range(0, n, 65536):
+        results.extend(sw.push(x[i : i + 65536]))
+    dt = time.perf_counter() - t0
+    sizes = [r["skyline"].shape[0] for r in results if r["window_filled"]]
+    return {
+        "config": name,
+        "n": n,
+        "dims": dims,
+        "window": window,
+        "slide": slide,
+        "tuples_per_sec": round(n / dt, 1),
+        "slides": len(results),
+        "skyline_size_median": int(np.median(sizes)) if sizes else 0,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=float, default=0.1)
+    ap.add_argument("--outdir", default="bench_out")
+    ap.add_argument("--only", help="substring filter on config names")
+    a = ap.parse_args(argv)
+    os.makedirs(a.outdir, exist_ok=True)
+    for name, dist, dims, algo, n in CONFIGS:
+        if a.only and a.only not in name:
+            continue
+        out = run_tumbling(name, dist, dims, algo, max(10_000, int(n * a.scale)),
+                           a.outdir)
+        print(json.dumps(out))
+    name, dist, dims, window, slide = SLIDING_CONFIG
+    if not a.only or a.only in name:
+        out = run_sliding(name, dist, dims,
+                          max(10_000, int(window * a.scale)),
+                          max(2_500, int(slide * a.scale)), a.outdir)
+        print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -129,8 +129,11 @@ def format_result(result: dict) -> str:
             out[k] = result[k]
     if "optimality" in out:
         out["optimality"] = float(f"{out['optimality']:.4f}")
-    if "skyline_points" in result:
-        out["skyline_points"] = result["skyline_points"]
+    # extension fields beyond the reference schema (partial-result marker,
+    # missing_partitions, skyline_points) ride along after the known fields
+    for k, v in result.items():
+        if k not in out:
+            out[k] = v
     return json.dumps(out)
 
 

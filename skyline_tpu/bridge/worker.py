@@ -49,6 +49,7 @@ class SkylineWorker:
         triggers = self._queries.poll(max_records)
         for t in triggers:
             self.engine.process_trigger(t)
+        self.engine.check_timeouts()
         for result in self.engine.poll_results():
             self.bus.produce(self.output_topic, format_result(result))
             self.results_emitted += 1

@@ -1,0 +1,57 @@
+"""Backend dispatch for the dominance hot ops.
+
+On TPU the Pallas kernels (VMEM-tiled, triangular-skip) are ~4x the XLA scan
+kernel; on CPU (tests, virtual meshes) Pallas would need interpret mode, so
+the scan kernel is used. Resolution happens once at first call.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def skyline_mask_auto(x, valid=None):
+    """Survivor mask with the fastest kernel for the active backend."""
+    if on_tpu():
+        from skyline_tpu.ops.pallas_dominance import skyline_mask_pallas
+
+        return skyline_mask_pallas(x, valid)
+    from skyline_tpu.ops.block_skyline import skyline_mask_scan
+
+    return skyline_mask_scan(x, valid)
+
+
+def skyline_keep_np(x):
+    """Survivor mask of a host (n, d) array via the backend's best kernel:
+    pad to a tile-friendly power-of-two capacity, mask on device, slice
+    back. The one shared implementation of the pad/mask/slice idiom (engine
+    global merge, sliding-window buckets)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skyline_tpu.utils.buckets import next_pow2
+
+    n, d = x.shape
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    cap = next_pow2(n, min_cap=1024)
+    pad = np.full((cap, d), np.inf, dtype=np.float32)
+    pad[:n] = x
+    valid = np.arange(cap) < n
+    return np.asarray(skyline_mask_auto(jnp.asarray(pad), jnp.asarray(valid)))[:n]
+
+
+def skyline_of_np(x, dims: int):
+    """Exact skyline points of a host (n, d) array (see skyline_keep_np)."""
+    import numpy as np
+
+    if x.shape[0] == 0:
+        return np.empty((0, dims), dtype=np.float32)
+    return x[skyline_keep_np(x)]

@@ -1,0 +1,177 @@
+"""Pallas TPU kernel for the dominance bitmask — the system's hot op.
+
+Computes, for every point of a set, whether ANY valid point dominates it
+(minimization: all(<=) and any(<)). This is the inner operation of both the
+local flush and the global merge; the XLA version (`skyline_mask_scan`)
+materializes (chunk, N) bool tiles through HBM, while this kernel keeps the
+whole (R, C) comparison tile in VMEM and fuses the per-dimension compare
+cascade with the row-reduction.
+
+Layout: points are fed TRANSPOSED as ``(d, N)`` so each dimension's
+coordinates lie contiguous along lanes — the (R, C) broadcast compare then
+maps directly onto the 8x128 VPU with no gather. The d-loop is a static
+Python unroll (d is tiny: 2-16).
+
+Grid is (col_tiles, row_tiles): all row tiles for one column tile run
+consecutively, accumulating the per-column "dominated" flags in the output
+block across the inner grid dimension (the standard Pallas reduce pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skyline_tpu.ops.dominance import PAD_VALUE
+
+# (rows=dominators, cols=victims) per VMEM tile. 512x1024 masks are 0.5 MB
+# each as int8-ish vregs; d<=16 keeps the unrolled compare cascade small.
+ROW_TILE = 512
+COL_TILE = 1024
+
+
+def _kernel_tri(d: int, x_ref, v_ref, y_ref, out_ref):
+    """Triangular variant: inputs are pre-sorted by coordinate sum ascending,
+    so a row (dominator) tile strictly after the column (victim) tile in sort
+    order can never dominate — the whole tile is skipped. Halves the work of
+    the self-skyline case."""
+    j, i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(i * ROW_TILE <= j * COL_TILE + (COL_TILE - 1))
+    def _compute():
+        le = jnp.ones((ROW_TILE, COL_TILE), dtype=jnp.bool_)
+        lt = jnp.zeros((ROW_TILE, COL_TILE), dtype=jnp.bool_)
+        for k in range(d):
+            xk = x_ref[k, :][:, None]
+            yk = y_ref[k, :][None, :]
+            le = le & (xk <= yk)
+            lt = lt | (xk < yk)
+        vmask = v_ref[0, :][:, None] > 0.5
+        dom = le & lt & vmask
+        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
+def _kernel(d: int, x_ref, v_ref, y_ref, out_ref):
+    # x_ref: (d, R) dominator coords; v_ref: (1, R) dominator validity as
+    # float32 (Mosaic can't reshape 1-bit vectors across the minor dim);
+    # y_ref: (d, C) victim coords; out_ref: (1, C) accumulated dominated flags
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    le = jnp.ones((ROW_TILE, COL_TILE), dtype=jnp.bool_)
+    lt = jnp.zeros((ROW_TILE, COL_TILE), dtype=jnp.bool_)
+    for k in range(d):  # static unroll over dimensions
+        xk = x_ref[k, :][:, None]  # (R, 1)
+        yk = y_ref[k, :][None, :]  # (1, C)
+        le = le & (xk <= yk)
+        lt = lt | (xk < yk)
+    vmask = v_ref[0, :][:, None] > 0.5  # (R, 1) from a 32-bit load
+    dom = le & lt & vmask
+    out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("triangular", "interpret"))
+def dominated_by_any_pallas(
+    xt: jax.Array,
+    valid: jax.Array,
+    triangular: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """dominated[j] = any valid i dominates j, over one transposed set.
+
+    xt: (d, N) float32 with PAD_VALUE columns for padding; valid: (N,) bool.
+    N must be a multiple of lcm(ROW_TILE, COL_TILE) — use ``skyline_mask_pallas``
+    which handles padding. Self-pairs are safe (a point never dominates
+    itself) and padding columns never dominate (+inf is never <=).
+    ``triangular=True`` requires rows sorted by coordinate sum ascending.
+    """
+    d, n = xt.shape
+    grid = (n // COL_TILE, n // ROW_TILE)
+    v2 = valid[None, :].astype(jnp.float32)  # (1, N), 32-bit for Mosaic
+    kern = _kernel_tri if triangular else _kernel
+    out = pl.pallas_call(
+        functools.partial(kern, d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d, ROW_TILE), lambda j, i: (0, i)),  # dominators
+            pl.BlockSpec((1, ROW_TILE), lambda j, i: (0, i)),  # their validity
+            pl.BlockSpec((d, COL_TILE), lambda j, i: (0, j)),  # victims
+        ],
+        out_specs=pl.BlockSpec((1, COL_TILE), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.bool_),
+        interpret=interpret,
+    )(xt, v2, xt)
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dominated_by_pallas(
+    xt: jax.Array, x_valid: jax.Array, yt: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Rectangular variant: dominated[j] = any valid x_i dominates y_j.
+
+    xt: (d, Nx) dominators (Nx % ROW_TILE == 0); yt: (d, Ny) victims
+    (Ny % COL_TILE == 0). The streaming flush's batch-vs-skyline prune maps
+    here directly.
+    """
+    d, nx = xt.shape
+    _, ny = yt.shape
+    grid = (ny // COL_TILE, nx // ROW_TILE)
+    v2 = x_valid[None, :].astype(jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_kernel, d),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d, ROW_TILE), lambda j, i: (0, i)),
+            pl.BlockSpec((1, ROW_TILE), lambda j, i: (0, i)),
+            pl.BlockSpec((d, COL_TILE), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, COL_TILE), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, ny), jnp.bool_),
+        interpret=interpret,
+    )(xt, v2, yt)
+    return out[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def skyline_mask_pallas(
+    x: jax.Array, valid: jax.Array | None = None, interpret: bool = False
+) -> jax.Array:
+    """Survivor mask over (N, d) points via the Pallas dominance kernel.
+
+    Semantically identical to ``skyline_mask`` / ``skyline_mask_scan``;
+    pads N up to a tile multiple internally, sum-sorts to exploit the
+    triangular skip, and unsorts the result.
+    """
+    n, d = x.shape
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    tile = max(ROW_TILE, COL_TILE)
+    padded = -(-n // tile) * tile
+    if padded != n:
+        pad_x = jnp.full((padded - n, d), PAD_VALUE, dtype=x.dtype)
+        x = jnp.concatenate([x, pad_x], axis=0)
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((padded - n,), dtype=bool)], axis=0
+        )
+    keys = jnp.where(valid, jnp.sum(x, axis=-1), jnp.inf)
+    order = jnp.argsort(keys, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    xs = x[order]
+    vs = valid[order]
+    dominated = dominated_by_any_pallas(
+        xs.T, vs, triangular=True, interpret=interpret
+    )
+    keep_sorted = ~dominated & vs
+    return keep_sorted[inv][:n]

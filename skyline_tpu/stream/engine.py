@@ -21,13 +21,13 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
-import jax.numpy as jnp
+
 import numpy as np
 
-from skyline_tpu.ops.block_skyline import skyline_mask_scan
+from skyline_tpu.ops.dispatch import skyline_keep_np
 from skyline_tpu.parallel.partitioners import partition_ids_np
 from skyline_tpu.bridge.wire import parse_trigger
-from skyline_tpu.stream.window import DEFAULT_BUFFER_SIZE, PartitionState, _next_pow2
+from skyline_tpu.stream.window import DEFAULT_BUFFER_SIZE, PartitionState
 
 
 @dataclass
@@ -44,6 +44,20 @@ class EngineConfig:
     emit_skyline_points: bool = False
     # device block size for the global-merge skyline pass
     merge_block: int = 2048
+    # failure detection: a query whose barrier never clears on some partition
+    # finalizes as a PARTIAL result after this long (0 = wait forever, the
+    # reference's behavior — its countdown latch hangs if a partition never
+    # reports, SURVEY.md §5)
+    query_timeout_ms: float = 0.0
+    # the reference's GridDominanceFilter (J10) — commented out there "for
+    # safety" over barrier-deadlock fears (FlinkSkyline.java:120-124,
+    # 717-734) — implemented here SAFELY: a tuple with every coordinate
+    # >= domain/2 (and one >) is dropped pre-routing, but only once a
+    # witness tuple with every coordinate <= domain/2 has been seen (the
+    # witness dominates-or-equals the midpoint, which by transitivity
+    # dominates the dropped tuple). Barriers are unaffected: max-seen-id
+    # advances before filtering.
+    grid_prefilter: bool = False
 
     @property
     def num_partitions(self) -> int:
@@ -87,6 +101,8 @@ class SkylineEngine:
         self._results: list[dict] = []
         self.records_in = 0
         self.dropped = 0
+        self.prefiltered = 0
+        self._midpoint_witness = False  # grid_prefilter safety latch
 
     # -- data plane -------------------------------------------------------
 
@@ -102,8 +118,31 @@ class SkylineEngine:
         if now_ms is None:
             now_ms = time.time() * 1000.0
         cfg = self.config
-        pids = partition_ids_np(values, cfg.algo, cfg.num_partitions, cfg.domain_max)
         self.records_in += values.shape[0]
+        pids = partition_ids_np(values, cfg.algo, cfg.num_partitions, cfg.domain_max)
+        doomed_pids: np.ndarray | None = None
+        if cfg.grid_prefilter:
+            mid = cfg.domain_max / 2.0
+            if not self._midpoint_witness and bool((values <= mid).all(axis=1).any()):
+                self._midpoint_witness = True
+            if self._midpoint_witness:
+                # advance each partition's barrier with the dropped rows'
+                # ids BEFORE filtering — the reference feared exactly this
+                # deadlock (a dropped tuple's id never reaching the barrier)
+                doomed = (values >= mid).all(axis=1) & (values > mid).any(axis=1)
+                if doomed.any():
+                    doomed_pids = np.unique(pids[doomed])
+                    for p in doomed_pids:
+                        part = self.partitions[p]
+                        mx = int(ids[doomed & (pids == p)].max())
+                        if part.start_time_ms is None:
+                            part.start_time_ms = now_ms
+                        part.max_seen_id = max(part.max_seen_id, mx)
+                    self.prefiltered += int(doomed.sum())
+                    keep = ~doomed
+                    values = values[keep]
+                    ids = ids[keep]
+                    pids = pids[keep]
         # group rows by partition with one argsort (the keyBy shuffle)
         order = np.argsort(pids, kind="stable")
         sorted_pids = pids[order]
@@ -117,6 +156,12 @@ class SkylineEngine:
             part = self.partitions[p]
             part.add_batch(sorted_vals[lo:hi], int(sorted_ids[lo:hi].max()), now_ms)
             self._recheck_pending(p, now_ms)
+        if doomed_pids is not None:
+            # partitions whose barrier advanced only via dropped rows still
+            # need their pending queries rechecked (after the kept rows of
+            # this batch have routed, so answers reflect the full batch)
+            for p in doomed_pids:
+                self._recheck_pending(int(p), now_ms)
 
     # -- control plane ----------------------------------------------------
 
@@ -163,7 +208,9 @@ class SkylineEngine:
         if len(q.partials) >= self.config.num_partitions:
             self._finalize(q, now_ms)
 
-    def _finalize(self, q: _QueryState, now_ms: float) -> None:
+    def _finalize(
+        self, q: _QueryState, now_ms: float, partial_missing: list[int] | None = None
+    ) -> None:
         """All partitions reported: global merge + metrics + result emission
         (GlobalSkylineAggregator final block, FlinkSkyline.java:573-657).
 
@@ -182,17 +229,7 @@ class SkylineEngine:
             else np.empty((0, self.config.dims), dtype=np.float32)
         )
 
-        n = union.shape[0]
-        if n:
-            cap = _next_pow2(n)
-            pad = np.full((cap, self.config.dims), np.inf, dtype=np.float32)
-            pad[:n] = union
-            valid = np.arange(cap) < n
-            keep = np.asarray(
-                skyline_mask_scan(jnp.asarray(pad), jnp.asarray(valid))
-            )[:n]
-        else:
-            keep = np.zeros((0,), dtype=bool)
+        keep = skyline_keep_np(union)
         global_sky = union[keep]
         survivors_per_pid = np.bincount(
             origins[keep], minlength=self.config.num_partitions
@@ -201,7 +238,8 @@ class SkylineEngine:
         merge_ms = (time.perf_counter_ns() - merge_t0) / 1e6
         now = now_ms + merge_ms
         job_start = min(q.start_times.values()) if q.start_times else now
-        map_finish = q.last_arrival_ms
+        # a pure-timeout finalize may have zero arrivals; anchor to now
+        map_finish = q.last_arrival_ms if q.last_arrival_ms else now
         local_ms = max(q.cpu_ms.values()) if q.cpu_ms else 0.0
         map_wall = max(0.0, map_finish - job_start)
         ingestion = max(0.0, map_wall - local_ms)
@@ -235,10 +273,42 @@ class SkylineEngine:
             "total_processing_time_ms": int(total_ms),
             "query_latency_ms": int(latency_ms),
         }
+        if partial_missing is not None:
+            result["partial"] = True
+            result["missing_partitions"] = partial_missing
         if self.config.emit_skyline_points:
             result["skyline_points"] = global_sky.tolist()
         self._results.append(result)
         self._inflight.pop(q.payload, None)
+
+    # -- failure detection -------------------------------------------------
+
+    def check_timeouts(self, now_ms: float | None = None) -> int:
+        """Finalize overdue queries as partial results (the watchdog the
+        reference lacks). A timed-out query emits with ``"partial": true``
+        and ``"missing_partitions"`` listing the non-reporting partitions;
+        its pending barrier entries are withdrawn. Returns the number of
+        queries timed out."""
+        timeout = self.config.query_timeout_ms
+        if timeout <= 0 or not self._inflight:
+            return 0
+        if now_ms is None:
+            now_ms = time.time() * 1000.0
+        overdue = [
+            q for q in self._inflight.values() if now_ms - q.dispatch_ms > timeout
+        ]
+        for q in overdue:
+            missing = [
+                p
+                for p in range(self.config.num_partitions)
+                if p not in q.partials
+            ]
+            for p in missing:
+                self._pending_queries[p] = [
+                    pq for pq in self._pending_queries[p] if pq is not q
+                ]
+            self._finalize(q, now_ms, partial_missing=missing)
+        return len(overdue)
 
     # -- results ----------------------------------------------------------
 

@@ -1,0 +1,98 @@
+"""Count-based sliding-window continuous skyline.
+
+BASELINE.json config #4 ("sliding-window continuous skyline, count-based,
+high window overlap"). The reference has no eviction at all — its skyline is
+over the whole unbounded stream — so this is a capability extension built on
+the same kernels.
+
+Skyline under deletion is handled with the standard bucket decomposition: a
+window of W tuples sliding by S is K = W/S buckets; each bucket keeps the
+skyline of ITS OWN tuples (computed once, when the bucket closes), and the
+window skyline is the skyline of the union of the K bucket skylines — exact
+by the merge law (SURVEY.md §4). Eviction is then O(1): drop the oldest
+bucket, no re-examination of "resurrected" points is ever needed because
+bucket skylines never pruned across buckets.
+
+Per-slide cost: one bucket skyline (S points) + one union merge
+(sum of K bucket skyline sizes), both on-device.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+
+import numpy as np
+
+from skyline_tpu.ops.dispatch import skyline_of_np as _device_skyline
+
+
+class SlidingSkyline:
+    """Continuous skyline over the last ``window_size`` tuples, emitting one
+    result every ``slide`` tuples. ``window_size % slide == 0``."""
+
+    def __init__(self, window_size: int, slide: int, dims: int):
+        if window_size % slide != 0:
+            raise ValueError(
+                f"window_size {window_size} must be a multiple of slide {slide}"
+            )
+        self.window_size = window_size
+        self.slide = slide
+        self.dims = dims
+        self.k = window_size // slide
+        self._buckets: deque[np.ndarray] = deque()  # per-bucket skylines
+        self._pending: list[np.ndarray] = []
+        self._pending_rows = 0
+        self._tuples_seen = 0
+        self.device_ns = 0
+
+    def push(self, values: np.ndarray) -> list[dict]:
+        """Feed a micro-batch; returns one result dict per completed slide:
+        ``{"window_end": id, "skyline": (k, d) array, "window_filled": bool}``
+        (window_filled is False while fewer than window_size tuples exist —
+        the result then covers the partial window, like any warmup period)."""
+        out = []
+        n = values.shape[0]
+        pos = 0
+        while pos < n:
+            take = min(self.slide - self._pending_rows, n - pos)
+            self._pending.append(values[pos : pos + take])
+            self._pending_rows += take
+            pos += take
+            self._tuples_seen += take
+            if self._pending_rows == self.slide:
+                out.append(self._close_bucket())
+        return out
+
+    def _close_bucket(self) -> dict:
+        t0 = time.perf_counter_ns()
+        rows = (
+            self._pending[0]
+            if len(self._pending) == 1
+            else np.concatenate(self._pending, axis=0)
+        )
+        self._pending = []
+        self._pending_rows = 0
+        self._buckets.append(_device_skyline(rows, self.dims))
+        if len(self._buckets) > self.k:
+            self._buckets.popleft()  # O(1) eviction of the oldest bucket
+        union = np.concatenate(list(self._buckets), axis=0)
+        sky = _device_skyline(union, self.dims)
+        self.device_ns += time.perf_counter_ns() - t0
+        return {
+            "window_end": self._tuples_seen - 1,
+            "skyline": sky,
+            "window_filled": len(self._buckets) == self.k,
+        }
+
+    @property
+    def current_skyline(self) -> np.ndarray:
+        """Skyline over the current (possibly partial) window, including
+        pending rows not yet forming a full slide."""
+        parts = list(self._buckets)
+        if self._pending_rows:
+            parts.append(np.concatenate(self._pending, axis=0))
+        if not parts:
+            return np.empty((0, self.dims), dtype=np.float32)
+        return _device_skyline(np.concatenate(parts, axis=0), self.dims)

@@ -24,13 +24,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from skyline_tpu.ops.dominance import compact, dominated_by, skyline_mask
+from skyline_tpu.ops.dispatch import on_tpu
 from skyline_tpu.utils.buckets import next_pow2
 
 # Reference flushes its input buffer at 5000 tuples (BUFFER_SIZE,
 # FlinkSkyline.java:232); we default to the nearest power of two.
 DEFAULT_BUFFER_SIZE = 4096
 
-_MIN_CAP = 256
+# Minimum buffer capacity: one full Pallas victim tile (COL_TILE), so every
+# capacity bucket satisfies the kernel's tile-multiple constraints.
+_MIN_CAP = 1024
 
 
 def _next_pow2(n: int) -> int:
@@ -62,6 +65,24 @@ def _merge_step(sky, sky_valid, batch, batch_valid, out_cap: int):
     return compact(x, keep, out_cap)
 
 
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def _merge_step_pallas(sky, sky_valid, batch, batch_valid, out_cap: int):
+    """TPU fast path of ``_merge_step``: the three dominance passes run in
+    the Pallas VMEM-tiled kernel (same mask logic, same transitivity
+    arguments). Requires sky/batch capacities to be tile multiples — the
+    _MIN_CAP floor and power-of-two bucketing guarantee that."""
+    from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
+
+    sky_t = sky.T
+    batch_t = batch.T
+    batch_local = batch_valid & ~dominated_by_pallas(batch_t, batch_valid, batch_t)
+    keep_batch = batch_local & ~dominated_by_pallas(sky_t, sky_valid, batch_t)
+    keep_sky = sky_valid & ~dominated_by_pallas(batch_t, keep_batch, sky_t)
+    x = jnp.concatenate([sky, batch], axis=0)
+    keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
+    return compact(x, keep, out_cap)
+
+
 class PartitionState:
     """Host-side handle for one logical partition (of ``2 x parallelism``);
     the skyline buffer itself is device-resident."""
@@ -77,7 +98,11 @@ class PartitionState:
         self._cap = _MIN_CAP
         self.sky = jnp.full((self._cap, dims), jnp.inf, dtype=jnp.float32)
         self.sky_valid = jnp.zeros((self._cap,), dtype=bool)
-        self.sky_count = 0
+        # survivor count: device scalar (exact, read lazily) + host upper
+        # bound (drives capacity growth WITHOUT a per-flush sync, so flushes
+        # dispatch asynchronously and partitions pipeline on the device)
+        self._count_dev = jnp.zeros((), dtype=jnp.int32)
+        self._count_ub = 0
         # barrier + metrics bookkeeping (FlinkSkyline.java:243-248, 267)
         self.max_seen_id: int = -1
         self.start_time_ms: float | None = None
@@ -126,8 +151,24 @@ class PartitionState:
             bpad = np.full((B, self.dims), np.inf, dtype=np.float32)
             bpad[: batch.shape[0]] = batch
             bvalid = np.arange(B) < batch.shape[0]
-            out_cap = max(self._cap, _next_pow2(self.sky_count + batch.shape[0]))
-            self.sky, self.sky_valid, count = _merge_step(
+            # capacity growth from the host-side upper bound: may grow a
+            # bucket early when pruning was strong, never too late
+            out_cap = max(
+                self._cap, _next_pow2(self._count_ub + batch.shape[0])
+            )
+            if out_cap > self._cap:
+                # about to grow: tighten the bound with ONE real count sync
+                # (growth events are log-bounded, so steady-state flushes
+                # stay fully async; without this the bound accumulates every
+                # ingested row and capacity tracks stream size, not skyline
+                # size)
+                self._count_ub = self.sky_count
+                out_cap = max(
+                    self._cap, _next_pow2(self._count_ub + batch.shape[0])
+                )
+            tile_ok = B % 1024 == 0 and self._cap % 1024 == 0 and out_cap % 1024 == 0
+            merge = _merge_step_pallas if (on_tpu() and tile_ok) else _merge_step
+            self.sky, self.sky_valid, self._count_dev = merge(
                 self.sky,
                 self.sky_valid,
                 jnp.asarray(bpad),
@@ -135,21 +176,35 @@ class PartitionState:
                 out_cap,
             )
             self._cap = out_cap
-            self.sky_count = int(count)  # one scalar sync per block
+            self._count_ub = min(out_cap, self._count_ub + batch.shape[0])
         self.processing_ns += time.perf_counter_ns() - t0
 
     # -- query ------------------------------------------------------------
 
+    @property
+    def sky_count(self) -> int:
+        """Exact survivor count (forces one device sync; prefer at query /
+        checkpoint boundaries only)."""
+        count = int(self._count_dev)
+        self._count_ub = count
+        return count
+
     def snapshot(self) -> np.ndarray:
         """Flush pending rows and return the local skyline (k, d) on host —
         the processQuery path (FlinkSkyline.java:367-403)."""
+        t0 = time.perf_counter_ns()
         self.flush()
-        return np.asarray(self.sky[: self.sky_count])
+        count = self.sky_count  # sync first, then transfer only count rows
+        out = np.asarray(self.sky[:count])
+        # the sync here absorbs all of this partition's in-flight flush work
+        self.processing_ns += time.perf_counter_ns() - t0
+        return out
 
     def skyline_host(self) -> np.ndarray:
         """Current device skyline pulled to host WITHOUT flushing pending
         rows (checkpointing reads state as-is)."""
-        return np.asarray(self.sky[: self.sky_count])
+        count = self.sky_count
+        return np.asarray(self.sky[:count])
 
     @property
     def processing_ms(self) -> float:

@@ -102,7 +102,8 @@ def load_engine(path: str) -> SkylineEngine:
             buf[: sky.shape[0]] = sky
             p.sky = jnp.asarray(buf)
             p.sky_valid = jnp.asarray(np.arange(cap) < sky.shape[0])
-            p.sky_count = sky.shape[0]
+            p._count_dev = jnp.asarray(sky.shape[0], dtype=jnp.int32)
+            p._count_ub = sky.shape[0]
             p._cap = cap
             pend = z[f"pending_{pm['id']}"]
             if pend.shape[0]:

@@ -70,10 +70,43 @@ def anti_correlated(
     return np.clip(np.trunc(scaled), d_min, d_max).astype(np.float32)
 
 
+def qos(rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float):
+    """QoS web-service workload (BASELINE.json config #5): latency,
+    throughput, availability, price — the reference repo's titular use case
+    (Flink-Skyline-**QoS**), though its producers only ship the three
+    synthetic distributions.
+
+    Skyline semantics are minimization in ALL dimensions, so
+    higher-is-better attributes (throughput, availability) are flipped into
+    the minimization space as ``d_max - value`` before emission. Shapes:
+    latency is log-normal-ish (many fast services, a long slow tail);
+    throughput anti-correlates with latency; availability is skewed toward
+    the top of the range; price weakly correlates with quality. ``dims`` > 4
+    appends uniform auxiliary attributes; ``dims`` < 4 truncates.
+    """
+    span = d_max - d_min
+    # latency: lognormal scaled into the domain, clipped
+    lat = d_min + np.clip(rng.lognormal(mean=0.0, sigma=0.8, size=n) / 6.0, 0, 1) * span
+    # throughput: anti-correlated with latency + noise (maximize)
+    thr = d_min + np.clip(1.0 - (lat - d_min) / span + rng.normal(0, 0.15, n), 0, 1) * span
+    # availability: skewed high (maximize)
+    avail = d_min + np.clip(rng.beta(8, 1.5, size=n), 0, 1) * span
+    # price: weakly correlated with quality (minimize)
+    qual = ((thr - d_min) + (avail - d_min)) / (2 * span)
+    price = d_min + np.clip(0.3 * qual + 0.7 * rng.random(n), 0, 1) * span
+    cols = [lat, d_max - (thr - d_min), d_max - (avail - d_min), price]
+    if dims < 4:
+        cols = cols[:dims]
+    elif dims > 4:
+        cols += [rng.uniform(d_min, d_max, size=n) for _ in range(dims - 4)]
+    return np.clip(np.trunc(np.stack(cols, axis=1)), d_min, d_max).astype(np.float32)
+
+
 GENERATORS = {
     "uniform": uniform,
     "correlated": correlated,
     "anti_correlated": anti_correlated,
+    "qos": qos,
 }
 
 

@@ -49,7 +49,9 @@ def main(argv=None):
     ap.add_argument("--count", type=int, default=0, help="stop after N records (0 = infinite)")
     ap.add_argument("--batch", type=int, default=8192)
     ap.add_argument("--seed", type=int, default=None)
-    ap.add_argument("--query-threshold", type=int, default=QUERY_THRESHOLD)
+    ap.add_argument("--query-threshold", type=int, default=QUERY_THRESHOLD,
+                    help="records per injected trigger; <= 0 disables triggers "
+                         "(the reference's data-only kafka_producer.py variant)")
     ap.add_argument("--sink", choices=["kafka", "stdout"], default="kafka")
     ap.add_argument("--bootstrap", default="localhost:9092")
     args = ap.parse_args(argv)
@@ -72,7 +74,7 @@ def main(argv=None):
         ]
         send(args.topic, lines)
         record_id += n
-        while record_id >= next_trigger:
+        while args.query_threshold > 0 and record_id >= next_trigger:
             # barrier = the threshold-crossing id, NOT the batch-end id: the
             # reference fires per-record at the threshold
             # (unified_producer.py:180-188); stamping the batch tail would

@@ -152,3 +152,29 @@ def test_skyline_mask_scan_with_padding(rng):
     keep = np.asarray(sms(vals, valid, chunk=32))
     assert not keep[77:].any()
     assert_same_set(np.asarray(vals)[keep], skyline_np(x))
+
+
+def test_skyline_mask_pallas_interpret_matches_dense(rng):
+    # Pallas kernels run in interpret mode on CPU: validates kernel logic
+    # (incl. the triangular skip + sum-sort wrapper) without TPU hardware
+    from skyline_tpu.ops.pallas_dominance import (
+        dominated_by_pallas,
+        skyline_mask_pallas,
+    )
+    from skyline_tpu.ops.dominance import dominated_by
+
+    x = rng.uniform(0, 1000, size=(1500, 4)).astype(np.float32)
+    dense = np.asarray(skyline_mask(jnp.asarray(x)))
+    pallas = np.asarray(skyline_mask_pallas(jnp.asarray(x), interpret=True))
+    np.testing.assert_array_equal(dense, pallas)
+
+    xd = rng.uniform(0, 1000, size=(512, 4)).astype(np.float32)
+    xv = rng.random(512) < 0.7
+    yv = rng.uniform(0, 1000, size=(1024, 4)).astype(np.float32)
+    a = np.asarray(dominated_by(jnp.asarray(yv), jnp.asarray(xd), jnp.asarray(xv)))
+    b = np.asarray(
+        dominated_by_pallas(
+            jnp.asarray(xd.T), jnp.asarray(xv), jnp.asarray(yv.T), interpret=True
+        )
+    )
+    np.testing.assert_array_equal(a, b)

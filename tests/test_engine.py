@@ -142,3 +142,88 @@ def test_incremental_flush_equals_batch(rng):
     eng_inc.process_trigger("0,1900")
     (ri,) = eng_inc.poll_results()
     assert ri["skyline_size"] == skyline_np(x).shape[0]
+
+
+def test_query_timeout_emits_partial(rng):
+    # failure detection: the reference's aggregator hangs forever if a
+    # partition never reports (SURVEY.md §5); with query_timeout_ms set the
+    # engine emits a partial result naming the missing partitions
+    cfg = EngineConfig(parallelism=1, algo="mr-dim", dims=2, buffer_size=64,
+                       query_timeout_ms=1.0)
+    eng = SkylineEngine(cfg)
+    x = rng.uniform(0, 400, size=(100, 2)).astype(np.float32)  # partition 0 only
+    _feed(eng, x)
+    eng.process_trigger("0,5000")  # barrier partition 0 can never clear
+    assert eng.poll_results() == []
+    import time as _t
+    _t.sleep(0.01)
+    assert eng.check_timeouts() == 1
+    (r,) = eng.poll_results()
+    assert r["partial"] is True
+    assert 0 in r["missing_partitions"]
+    # partition 1 was empty (-1) and answered immediately with an empty
+    # skyline; partition 0 is the missing one, so the partial merge is empty
+    assert r["skyline_size"] == 0
+    assert eng.inflight_queries == 0
+
+
+def test_no_timeout_when_disabled(rng):
+    eng = SkylineEngine(EngineConfig(parallelism=1, algo="mr-dim", dims=2,
+                                     buffer_size=64))
+    _feed(eng, rng.uniform(0, 400, size=(50, 2)).astype(np.float32))
+    eng.process_trigger("0,5000")
+    assert eng.check_timeouts() == 0
+    assert eng.inflight_queries == 1  # reference behavior: waits forever
+
+
+def test_grid_prefilter_exact_and_barrier_safe(rng):
+    # J10 done right: same skyline with and without the prefilter, and the
+    # barrier still clears even when whole batches are dropped
+    x = rng.uniform(0, 1000, size=(4000, 3)).astype(np.float32)
+    base = SkylineEngine(EngineConfig(parallelism=2, algo="mr-grid", dims=3,
+                                      buffer_size=256))
+    _feed(base, x)
+    base.process_trigger("0,0")
+    (rb,) = base.poll_results()
+
+    filt = SkylineEngine(EngineConfig(parallelism=2, algo="mr-grid", dims=3,
+                                      buffer_size=256, grid_prefilter=True))
+    _feed(filt, x)
+    # mixed tail: normal rows (spread over all partitions) then doomed rows
+    # (all dims > mid -> the top grid cell); the doomed ids are the HIGHEST,
+    # so the top cell's partition clears the barrier only if dropped rows
+    # still advance it
+    normal = rng.uniform(0, 1000, size=(200, 3)).astype(np.float32)
+    doomed = rng.uniform(600, 1000, size=(100, 3)).astype(np.float32)
+    filt.process_trigger("1,4150")  # inside the normal tail
+    assert filt.poll_results() == []
+    _feed(filt, normal, start_id=4000)      # ids 4000..4199
+    before = filt.prefiltered  # uniform feeds also shed their all-high rows
+    _feed(filt, doomed, start_id=4200)      # ids 4200..4299, all dropped
+    (rf,) = filt.poll_results()
+    assert rf["query_id"] == "1"
+    assert filt.prefiltered - before == 100
+    # the top-cell partition's barrier advanced via dropped rows' ids
+    top_cell_pid = 7 % filt.config.num_partitions
+    assert filt.partitions[top_cell_pid].max_seen_id == 4299
+    # doomed rows are all dominated, so the skyline matches the unfiltered
+    # oracle over the kept rows
+    full = np.concatenate([x, normal])
+    assert rf["skyline_size"] == skyline_np(
+        np.concatenate([full, doomed])
+    ).shape[0] == skyline_np(full).shape[0]
+    assert rb["skyline_size"] == skyline_np(x).shape[0]
+
+
+def test_grid_prefilter_waits_for_witness():
+    # without a witness (no tuple <= midpoint in all dims), nothing may be
+    # dropped — the midpoint alone is not a real dominator
+    eng = SkylineEngine(EngineConfig(parallelism=1, algo="mr-grid", dims=2,
+                                     domain_max=1000.0, buffer_size=64,
+                                     grid_prefilter=True))
+    high = np.array([[800.0, 600.0], [600.0, 800.0]], dtype=np.float32)
+    eng.process_records(np.arange(2, dtype=np.int64), high)
+    assert eng.prefiltered == 0
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    assert r["skyline_size"] == 2  # both incomparable, both kept

@@ -75,3 +75,13 @@ def test_parse_tuple_lines_drops_out_of_range_id():
     ids, vals, dropped = parse_tuple_lines(lines, dims=2)
     assert list(ids) == [1]
     assert dropped == 1
+
+
+def test_format_result_keeps_extension_fields():
+    # partial-result markers must survive wire serialization (the worker
+    # emits through format_result)
+    s = format_result({"query_id": "1", "skyline_size": 0, "partial": True,
+                       "missing_partitions": [0, 3]})
+    parsed = json.loads(s)
+    assert parsed["partial"] is True
+    assert parsed["missing_partitions"] == [0, 3]

@@ -49,3 +49,19 @@ def test_anti_correlated_hugs_antidiagonal(rng):
     # target sum band: mean=10000, slack=0.0005*10000*2=10 (plus trunc/clip)
     inside = np.abs(sums - 10000) < 50
     assert inside.mean() > 0.95
+
+
+def test_qos_workload(rng):
+    from skyline_tpu.workload.generators import qos
+
+    x = generate("qos", rng, 5000, 4, 0, 10000)
+    assert x.shape == (5000, 4)
+    assert (x >= 0).all() and (x <= 10000).all()
+    # maximize-dims are flipped: good services (high thr/avail) have LOW
+    # flipped values, so the skyline prefers them; sanity: skyline is small
+    # vs anti-correlated but non-trivial
+    s = skyline_np(x)
+    assert 4 <= s.shape[0] <= 2500
+    # dims truncation/extension
+    assert generate("qos", rng, 100, 2, 0, 100).shape == (100, 2)
+    assert generate("qos", rng, 100, 6, 0, 100).shape == (100, 6)
