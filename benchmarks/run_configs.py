@@ -104,9 +104,11 @@ def main(argv=None):
         print(json.dumps(out))
     name, dist, dims, window, slide = SLIDING_CONFIG
     if not a.only or a.only in name:
-        out = run_sliding(name, dist, dims,
-                          max(10_000, int(window * a.scale)),
-                          max(2_500, int(slide * a.scale)), a.outdir)
+        # derive slide first and keep window an exact multiple of it
+        # (SlidingSkyline requires window_size % slide == 0 at any --scale)
+        k = window // slide
+        s = max(2_500, int(slide * a.scale))
+        out = run_sliding(name, dist, dims, k * s, s, a.outdir)
         print(json.dumps(out))
     return 0
 

@@ -239,7 +239,9 @@ class SkylineEngine:
         now = now_ms + merge_ms
         job_start = min(q.start_times.values()) if q.start_times else now
         # a pure-timeout finalize may have zero arrivals; anchor to now
-        map_finish = q.last_arrival_ms if q.last_arrival_ms else now
+        # (test q.partials, not the timestamp — an injected clock at 0.0 is a
+        # legitimate arrival time)
+        map_finish = q.last_arrival_ms if q.partials else now
         local_ms = max(q.cpu_ms.values()) if q.cpu_ms else 0.0
         map_wall = max(0.0, map_finish - job_start)
         ingestion = max(0.0, map_wall - local_ms)

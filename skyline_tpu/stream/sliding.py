@@ -57,7 +57,9 @@ class SlidingSkyline:
         pos = 0
         while pos < n:
             take = min(self.slide - self._pending_rows, n - pos)
-            self._pending.append(values[pos : pos + take])
+            # copy: pending rows outlive this call and the caller may reuse
+            # its batch buffer
+            self._pending.append(np.array(values[pos : pos + take]))
             self._pending_rows += take
             pos += take
             self._tuples_seen += take

@@ -145,7 +145,10 @@ class PartitionState:
         self._pending = []
         self._pending_rows = 0
 
-        B = max(self.buffer_size, _MIN_CAP)
+        # round the flush batch up to a whole Pallas victim tile so the TPU
+        # fast path stays available for ANY buffer_size (e.g. the reference's
+        # 5000); the pad rows are synthesized below either way
+        B = -(-max(self.buffer_size, _MIN_CAP) // _MIN_CAP) * _MIN_CAP
         for lo in range(0, rows.shape[0], B):
             batch = rows[lo : lo + B]
             bpad = np.full((B, self.dims), np.inf, dtype=np.float32)
@@ -166,8 +169,9 @@ class PartitionState:
                 out_cap = max(
                     self._cap, _next_pow2(self._count_ub + batch.shape[0])
                 )
-            tile_ok = B % 1024 == 0 and self._cap % 1024 == 0 and out_cap % 1024 == 0
-            merge = _merge_step_pallas if (on_tpu() and tile_ok) else _merge_step
+            # B is a _MIN_CAP multiple by construction and capacities are
+            # powers of two >= _MIN_CAP, so tile constraints always hold
+            merge = _merge_step_pallas if on_tpu() else _merge_step
             self.sky, self.sky_valid, self._count_dev = merge(
                 self.sky,
                 self.sky_valid,

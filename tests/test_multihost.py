@@ -1,0 +1,104 @@
+"""Hierarchical (host, chip) two-phase skyline: exactness, overflow semantics,
+mesh-shape invariance — on the 8-virtual-device CPU platform (conftest)."""
+
+import jax
+import numpy as np
+import pytest
+
+from skyline_tpu.ops.dominance import skyline_np
+from skyline_tpu.parallel.multihost import (
+    build_hierarchical_two_phase,
+    make_host_chip_mesh,
+    shard_rows_2d,
+)
+
+from conftest import assert_same_set
+
+
+def _run(mesh, x, valid, host_cap=None):
+    shards = int(mesh.devices.size)
+    rows_per_shard = x.shape[0] // shards
+    step = build_hierarchical_two_phase(
+        mesh, rows_per_shard=rows_per_shard, host_cap=host_cap, local_block=64,
+        cross_block=128,
+    )
+    xs, vs = shard_rows_2d(mesh, x, valid)
+    host_keep, global_keep, overflowed = step(xs, vs)
+    return (
+        np.asarray(host_keep),
+        np.asarray(global_keep),
+        int(overflowed),
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_exact_vs_oracle(rng, shape):
+    mesh = make_host_chip_mesh(*shape)
+    n, d = 512, 4
+    x = rng.uniform(0, 100, size=(n, d)).astype(np.float32)
+    valid = np.ones(n, dtype=bool)
+    host_keep, global_keep, overflowed = _run(mesh, x, valid)
+    assert overflowed == 0
+    assert_same_set(x[global_keep], skyline_np(x))
+    # host survivors are a superset of global survivors
+    assert np.all(host_keep[global_keep])
+
+
+def test_mesh_shape_invariance(rng):
+    n, d = 512, 3
+    x = rng.uniform(0, 100, size=(n, d)).astype(np.float32)
+    valid = np.ones(n, dtype=bool)
+    results = []
+    for shape in [(2, 4), (4, 2), (1, 8)]:
+        mesh = make_host_chip_mesh(*shape)
+        _, global_keep, overflowed = _run(mesh, x, valid)
+        assert overflowed == 0
+        results.append(x[global_keep])
+    assert_same_set(results[0], results[1])
+    assert_same_set(results[0], results[2])
+
+
+def test_padding_rows_excluded(rng):
+    mesh = make_host_chip_mesh(2, 4)
+    n, d = 256, 3
+    x = rng.uniform(0, 100, size=(n, d)).astype(np.float32)
+    x[200:] = np.inf
+    valid = np.arange(n) < 200
+    _, global_keep, overflowed = _run(mesh, x, valid)
+    assert overflowed == 0
+    assert not global_keep[200:].any()
+    assert_same_set(x[global_keep], skyline_np(x[:200]))
+
+
+def test_overflow_flag_and_superset(rng):
+    """An undersized host_cap must raise the overflow flag and may only ADD
+    points relative to the true skyline (dominators dropped, never results)."""
+    mesh = make_host_chip_mesh(2, 4)
+    n, d = 8192, 8
+    # anti-correlated-ish: most points survive locally -> host buffers overflow
+    base = rng.uniform(0, 100, size=(n, 1)).astype(np.float32)
+    x = np.concatenate([base, 100.0 - base + rng.normal(0, 0.01, size=(n, 1))], axis=1)
+    x = np.concatenate([x, rng.uniform(0, 100, size=(n, d - 2))], axis=1).astype(
+        np.float32
+    )
+    valid = np.ones(n, dtype=bool)
+    _, keep_exact, ov0 = _run(mesh, x, valid)
+    assert ov0 == 0
+    _, keep_capped, ov1 = _run(mesh, x, valid, host_cap=1024)
+    assert ov1 > 0
+    # superset: every exact survivor is still kept
+    assert np.all(keep_capped[keep_exact])
+
+
+def test_large_host_cap_multiple_rejected():
+    mesh = make_host_chip_mesh(2, 4)
+    with pytest.raises(ValueError):
+        build_hierarchical_two_phase(mesh, rows_per_shard=64, host_cap=100)
+
+
+def test_make_mesh_shapes():
+    mesh = make_host_chip_mesh(2, 4)
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("host", "chip")
+    with pytest.raises(ValueError):
+        make_host_chip_mesh(3)  # 8 % 3 != 0
