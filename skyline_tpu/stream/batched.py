@@ -1,0 +1,315 @@
+"""Batched partition-set state: all logical partitions' skylines in one
+stacked device buffer, merged in one launch.
+
+The per-partition model (``window.PartitionState``) dispatches 3 dominance
+kernels + a compact per partition per flush — ~P*4 launches per micro-batch.
+Through a dispatch-latency-bound link (the remote-TPU tunnel adds ~10s of ms
+per launch) that overhead dominates the actual VPU work by an order of
+magnitude. ``PartitionSet`` keeps the SAME semantics (per-partition
+incremental skylines, barriers, timing — SkylineLocalProcessor's state model,
+FlinkSkyline.java:214-445) but stores all P partitions as ``(P, cap, d)`` /
+``(P, cap)`` stacked buffers and merges every partition's pending rows in ONE
+vmapped kernel launch per flush.
+
+Semantic deltas vs per-partition flushing, both documented here on purpose:
+
+- flush granularity: a flush happens when the LARGEST partition's pending
+  rows reach ``buffer_size`` (or on demand), and it flushes ALL partitions'
+  pending rows at once. Results are identical — the incremental merge is
+  order- and batching-invariant (the merge law, SURVEY.md §4) — only the
+  points at which device work happens differ.
+- per-partition CPU attribution: flush wall time is accounted to the set,
+  and every partition reports the same ``processing_ms`` (the set total).
+  The reference's per-query ``local_processing_time_ms`` is the MAX over
+  partitions (FlinkSkyline.java:579-588), which under shared attribution is
+  exactly the set total — the number the dashboard stacks local bars from.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from skyline_tpu.ops.dispatch import on_tpu
+from skyline_tpu.stream.window import (
+    DEFAULT_BUFFER_SIZE,
+    _MIN_CAP,
+    _merge_step_batched,
+    _merge_step_pallas_batched,
+    _next_pow2,
+)
+
+
+class PartitionSet:
+    """Device-stacked state for ``num_partitions`` logical partitions."""
+
+    def __init__(
+        self,
+        num_partitions: int,
+        dims: int,
+        buffer_size: int = DEFAULT_BUFFER_SIZE,
+    ):
+        self.num_partitions = num_partitions
+        self.dims = dims
+        self.buffer_size = buffer_size
+        p = num_partitions
+        # pending micro-batch rows awaiting a flush, per partition
+        self._pending: list[list[np.ndarray]] = [[] for _ in range(p)]
+        self._pending_rows = np.zeros(p, dtype=np.int64)
+        # stacked running skylines: (P, cap, d) values + (P, cap) validity
+        self._cap = _MIN_CAP
+        self.sky = jnp.full((p, self._cap, dims), jnp.inf, dtype=jnp.float32)
+        self.sky_valid = jnp.zeros((p, self._cap), dtype=bool)
+        # survivor counts: device vector (exact, read lazily) + host upper
+        # bounds (drive capacity growth WITHOUT per-flush syncs)
+        self._count_dev = jnp.zeros((p,), dtype=jnp.int32)
+        self._count_ub = np.zeros(p, dtype=np.int64)
+        # barrier + metrics bookkeeping (FlinkSkyline.java:243-248, 267)
+        self.max_seen_id = np.full(p, -1, dtype=np.int64)
+        self.start_time_ms: list[float | None] = [None] * p
+        self.records_seen = np.zeros(p, dtype=np.int64)
+        self.processing_ns: int = 0  # set-wide (see module docstring)
+        # host-side caches of device state, invalidated by flush/restore:
+        # repeated per-partition snapshots (e.g. a trigger answering all P
+        # partitions) then cost ONE count sync + ONE buffer transfer total
+        self._counts_cache: np.ndarray | None = None
+        self._host_cache: np.ndarray | None = None
+
+    # -- ingest -----------------------------------------------------------
+
+    def add_batch(
+        self, p: int, values: np.ndarray, max_id: int, now_ms: float
+    ) -> None:
+        """Buffer a routed micro-batch for partition ``p``; the caller
+        decides when to ``flush_all`` (usually via ``maybe_flush``)."""
+        n = values.shape[0]
+        if n == 0:
+            return
+        if self.start_time_ms[p] is None:
+            self.start_time_ms[p] = now_ms
+        self.max_seen_id[p] = max(self.max_seen_id[p], int(max_id))
+        self.records_seen[p] += n
+        self._pending[p].append(values)
+        self._pending_rows[p] += n
+
+    def maybe_flush(self) -> bool:
+        """Flush all partitions once the largest pending buffer reaches
+        ``buffer_size`` (the processBuffer threshold, FlinkSkyline.java:232,
+        applied set-wide). Returns True if a flush happened."""
+        if int(self._pending_rows.max()) >= self.buffer_size:
+            self.flush_all()
+            return True
+        return False
+
+    def flush_all(self) -> None:
+        """Merge every partition's pending rows into its running skyline in
+        one batched device launch (or a few, if one partition's pending
+        vastly exceeds the common batch bucket)."""
+        total = int(self._pending_rows.sum())
+        if total == 0:
+            return
+        t0 = time.perf_counter_ns()
+        rows = [
+            (
+                self._pending[p][0]
+                if len(self._pending[p]) == 1
+                else np.concatenate(self._pending[p], axis=0)
+            )
+            if self._pending[p]
+            else np.empty((0, self.dims), dtype=np.float32)
+            for p in range(self.num_partitions)
+        ]
+        self._pending = [[] for _ in range(self.num_partitions)]
+        self._pending_rows[:] = 0
+
+        max_rows = max(r.shape[0] for r in rows)
+        # one common power-of-two batch bucket B; partitions with more than B
+        # pending rows (heavy skew) take extra rounds
+        B = _next_pow2(min(max_rows, max(self.buffer_size, _MIN_CAP)))
+        n_rounds = -(-max_rows // B)
+        for rnd in range(n_rounds):
+            batch = np.full(
+                (self.num_partitions, B, self.dims), np.inf, dtype=np.float32
+            )
+            bvalid = np.zeros((self.num_partitions, B), dtype=bool)
+            widths = np.zeros(self.num_partitions, dtype=np.int64)
+            for p, r in enumerate(rows):
+                part_rows = r[rnd * B : (rnd + 1) * B]
+                w = part_rows.shape[0]
+                if w:
+                    batch[p, :w] = part_rows
+                    bvalid[p, :w] = True
+                    widths[p] = w
+            out_cap = max(self._cap, _next_pow2(int((self._count_ub + widths).max())))
+            if out_cap > self._cap:
+                # about to grow: tighten the bounds with ONE real count sync
+                # (growth events are log-bounded, so steady-state flushes
+                # stay fully async)
+                self._count_ub = np.asarray(self._count_dev, dtype=np.int64)
+                out_cap = max(
+                    self._cap, _next_pow2(int((self._count_ub + widths).max()))
+                )
+            merge = (
+                _merge_step_pallas_batched if on_tpu() else _merge_step_batched
+            )
+            self.sky, self.sky_valid, self._count_dev = merge(
+                self.sky,
+                self.sky_valid,
+                jnp.asarray(batch),
+                jnp.asarray(bvalid),
+                out_cap,
+            )
+            self._cap = out_cap
+            self._count_ub = np.minimum(out_cap, self._count_ub + widths)
+        self._counts_cache = None
+        self._host_cache = None
+        self.processing_ns += time.perf_counter_ns() - t0
+
+    # -- query ------------------------------------------------------------
+
+    def sky_counts(self) -> np.ndarray:
+        """Exact survivor counts (P,) — one device sync (cached until the
+        next flush)."""
+        if self._counts_cache is None:
+            self._counts_cache = np.asarray(self._count_dev, dtype=np.int64)
+            self._count_ub = self._counts_cache.copy()
+        return self._counts_cache
+
+    def _host_sky(self) -> np.ndarray:
+        if self._host_cache is None:
+            self._host_cache = np.asarray(self.sky)
+        return self._host_cache
+
+    def snapshot(self, p: int) -> np.ndarray:
+        """Flush pending rows and return partition ``p``'s local skyline
+        (k, d) on host — the processQuery path (FlinkSkyline.java:367-403)."""
+        t0 = time.perf_counter_ns()
+        self.flush_all()
+        count = int(self.sky_counts()[p])
+        out = self._host_sky()[p, :count].copy()
+        self.processing_ns += time.perf_counter_ns() - t0
+        return out
+
+    def skyline_host(self, p: int) -> np.ndarray:
+        """Partition ``p``'s device skyline pulled to host WITHOUT flushing
+        pending rows (checkpointing reads state as-is)."""
+        count = int(self.sky_counts()[p])
+        return self._host_sky()[p, :count].copy()
+
+    def pending_rows_of(self, p: int) -> np.ndarray:
+        """Partition ``p``'s un-flushed pending rows as one (m, d) array."""
+        if not self._pending[p]:
+            return np.empty((0, self.dims), dtype=np.float32)
+        if len(self._pending[p]) == 1:
+            return self._pending[p][0]
+        return np.concatenate(self._pending[p], axis=0)
+
+    def restore_all(
+        self, skies: list[np.ndarray], pendings: list[np.ndarray]
+    ) -> None:
+        """Checkpoint-restore every partition's skyline + pending buffer in
+        one host pass and one device upload.
+
+        ``skies[p]`` rows are assumed mutually non-dominated (they came from
+        ``skyline_host``). Replaces all existing state.
+        """
+        assert len(skies) == len(pendings) == self.num_partitions
+        counts = np.array([s.shape[0] for s in skies], dtype=np.int64)
+        cap = _next_pow2(max(int(counts.max()), 1))
+        svals = np.full(
+            (self.num_partitions, cap, self.dims), np.inf, dtype=np.float32
+        )
+        svalid = np.zeros((self.num_partitions, cap), dtype=bool)
+        for p, sky in enumerate(skies):
+            k = sky.shape[0]
+            svals[p, :k] = sky
+            svalid[p, :k] = True
+        self.sky = jnp.asarray(svals)
+        self.sky_valid = jnp.asarray(svalid)
+        self._count_dev = jnp.asarray(counts.astype(np.int32))
+        self._count_ub = counts.copy()
+        self._cap = cap
+        self._counts_cache = None
+        self._host_cache = None
+        for p, pending in enumerate(pendings):
+            if pending.shape[0]:
+                self._pending[p] = [pending]
+                self._pending_rows[p] = pending.shape[0]
+            else:
+                self._pending[p] = []
+                self._pending_rows[p] = 0
+
+    @property
+    def processing_ms(self) -> float:
+        return self.processing_ns / 1e6
+
+
+class PartitionView:
+    """Per-partition facade over a ``PartitionSet`` with the same surface as
+    ``window.PartitionState`` — the engine and checkpointing address
+    partitions individually while storage stays stacked.
+
+    One deliberate contract delta vs ``PartitionState``: ``add_batch`` does
+    NOT auto-flush at the buffer threshold. Flush policy belongs to the set
+    (one batched launch for all partitions) — the owner must call
+    ``PartitionSet.maybe_flush()`` after routing a micro-batch, as
+    ``SkylineEngine.process_records`` does. ``snapshot`` still flushes, so
+    query results never miss pending rows either way."""
+
+    __slots__ = ("_set", "partition_id")
+
+    def __init__(self, pset: PartitionSet, p: int):
+        self._set = pset
+        self.partition_id = p
+
+    # bookkeeping fields (read/write, used by the engine's barrier +
+    # grid-prefilter paths)
+    @property
+    def max_seen_id(self) -> int:
+        return int(self._set.max_seen_id[self.partition_id])
+
+    @max_seen_id.setter
+    def max_seen_id(self, v: int) -> None:
+        self._set.max_seen_id[self.partition_id] = v
+
+    @property
+    def start_time_ms(self):
+        return self._set.start_time_ms[self.partition_id]
+
+    @start_time_ms.setter
+    def start_time_ms(self, v) -> None:
+        self._set.start_time_ms[self.partition_id] = v
+
+    @property
+    def records_seen(self) -> int:
+        return int(self._set.records_seen[self.partition_id])
+
+    @records_seen.setter
+    def records_seen(self, v: int) -> None:
+        self._set.records_seen[self.partition_id] = v
+
+    @property
+    def processing_ns(self) -> int:
+        return self._set.processing_ns
+
+    @property
+    def processing_ms(self) -> float:
+        return self._set.processing_ms
+
+    def add_batch(self, values: np.ndarray, max_id: int, now_ms: float) -> None:
+        self._set.add_batch(self.partition_id, values, max_id, now_ms)
+
+    def flush(self) -> None:
+        self._set.flush_all()
+
+    def snapshot(self) -> np.ndarray:
+        return self._set.snapshot(self.partition_id)
+
+    def skyline_host(self) -> np.ndarray:
+        return self._set.skyline_host(self.partition_id)
+
+    @property
+    def sky_count(self) -> int:
+        return int(self._set.sky_counts()[self.partition_id])

@@ -27,7 +27,8 @@ import numpy as np
 from skyline_tpu.ops.dispatch import skyline_keep_np
 from skyline_tpu.parallel.partitioners import partition_ids_np
 from skyline_tpu.bridge.wire import parse_trigger
-from skyline_tpu.stream.window import DEFAULT_BUFFER_SIZE, PartitionState
+from skyline_tpu.stream.batched import PartitionSet, PartitionView
+from skyline_tpu.stream.window import DEFAULT_BUFFER_SIZE
 
 
 @dataclass
@@ -90,9 +91,14 @@ class SkylineEngine:
 
     def __init__(self, config: EngineConfig):
         self.config = config
+        # stacked device state: all partitions' skylines merge in ONE launch
+        # per flush (see stream/batched.py); `partitions` are per-partition
+        # facades over it
+        self.pset = PartitionSet(
+            config.num_partitions, config.dims, config.buffer_size
+        )
         self.partitions = [
-            PartitionState(i, config.dims, config.buffer_size)
-            for i in range(config.num_partitions)
+            PartitionView(self.pset, i) for i in range(config.num_partitions)
         ]
         self._pending_queries: dict[int, list[_QueryState]] = {
             i: [] for i in range(config.num_partitions)
@@ -156,6 +162,8 @@ class SkylineEngine:
             part = self.partitions[p]
             part.add_batch(sorted_vals[lo:hi], int(sorted_ids[lo:hi].max()), now_ms)
             self._recheck_pending(p, now_ms)
+        # one batched launch merges every partition's pending rows at once
+        self.pset.maybe_flush()
         if doomed_pids is not None:
             # partitions whose barrier advanced only via dropped rows still
             # need their pending queries rechecked (after the kept rows of

@@ -16,7 +16,6 @@ bounded number of executables.
 
 from __future__ import annotations
 
-import functools
 import time
 
 import jax
@@ -40,8 +39,7 @@ def _next_pow2(n: int) -> int:
     return next_pow2(n, min_cap=_MIN_CAP)
 
 
-@functools.partial(jax.jit, static_argnames=("out_cap",))
-def _merge_step(sky, sky_valid, batch, batch_valid, out_cap: int):
+def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     """One windowed-BNL step: merge a new batch into a running skyline and
     compact survivors into a fresh ``out_cap`` buffer.
 
@@ -65,10 +63,9 @@ def _merge_step(sky, sky_valid, batch, batch_valid, out_cap: int):
     return compact(x, keep, out_cap)
 
 
-@functools.partial(jax.jit, static_argnames=("out_cap",))
-def _merge_step_pallas(sky, sky_valid, batch, batch_valid, out_cap: int):
-    """TPU fast path of ``_merge_step``: the three dominance passes run in
-    the Pallas VMEM-tiled kernel (same mask logic, same transitivity
+def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
+    """TPU fast path of ``_merge_step_core``: the three dominance passes run
+    in the Pallas VMEM-tiled kernel (same mask logic, same transitivity
     arguments). Requires sky/batch capacities to be tile multiples — the
     _MIN_CAP floor and power-of-two bucketing guarantee that."""
     from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
@@ -81,6 +78,24 @@ def _merge_step_pallas(sky, sky_valid, batch, batch_valid, out_cap: int):
     x = jnp.concatenate([sky, batch], axis=0)
     keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
     return compact(x, keep, out_cap)
+
+
+_merge_step = jax.jit(_merge_step_core, static_argnames=("out_cap",))
+_merge_step_pallas = jax.jit(_merge_step_pallas_core, static_argnames=("out_cap",))
+
+# Batched variants: merge P partitions' flushes in ONE device launch
+# (sky (P, cap, d), batch (P, B, d) -> (P, out_cap, d)). Streaming through a
+# dispatch-latency-bound link (the remote-TPU tunnel) is launch-count-bound,
+# so collapsing P per-partition merges into one vmapped executable is the
+# difference between ~P*3 launches per micro-batch and ~1.
+_merge_step_batched = jax.jit(
+    jax.vmap(_merge_step_core, in_axes=(0, 0, 0, 0, None)),
+    static_argnames=("out_cap",),
+)
+_merge_step_pallas_batched = jax.jit(
+    jax.vmap(_merge_step_pallas_core, in_axes=(0, 0, 0, 0, None)),
+    static_argnames=("out_cap",),
+)
 
 
 class PartitionState:

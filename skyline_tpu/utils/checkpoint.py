@@ -16,7 +16,6 @@ import json
 import numpy as np
 
 from skyline_tpu.stream.engine import EngineConfig, SkylineEngine, _QueryState
-from skyline_tpu.stream.window import _next_pow2
 
 _FORMAT_VERSION = 1
 
@@ -43,18 +42,18 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
         "results": engine._results,
     }
     for p in engine.partitions:
-        pend = (
-            np.concatenate(p._pending, axis=0)
-            if p._pending
-            else np.empty((0, cfg.dims), dtype=np.float32)
-        )
         arrays[f"sky_{p.partition_id}"] = p.skyline_host()
-        arrays[f"pending_{p.partition_id}"] = pend
+        arrays[f"pending_{p.partition_id}"] = engine.pset.pending_rows_of(
+            p.partition_id
+        )
         meta["partitions"].append(
             {
                 "id": p.partition_id,
                 "max_seen_id": p.max_seen_id,
                 "start_time_ms": p.start_time_ms,
+                # CPU attribution is set-wide (stream/batched.py); every
+                # partition records the set total, and load takes the max,
+                # which also merges old per-partition checkpoints correctly
                 "processing_ns": p.processing_ns,
                 "records_seen": p.records_seen,
             }
@@ -92,27 +91,20 @@ def load_engine(path: str) -> SkylineEngine:
         engine.records_in = meta["records_in"]
         engine.dropped = meta["dropped"]
         engine._results = meta["results"]
-        import jax.numpy as jnp
 
-        for pm in meta["partitions"]:
-            p = engine.partitions[pm["id"]]
-            sky = z[f"sky_{pm['id']}"]
-            cap = _next_pow2(max(sky.shape[0], 1))
-            buf = np.full((cap, cfg.dims), np.inf, dtype=np.float32)
-            buf[: sky.shape[0]] = sky
-            p.sky = jnp.asarray(buf)
-            p.sky_valid = jnp.asarray(np.arange(cap) < sky.shape[0])
-            p._count_dev = jnp.asarray(sky.shape[0], dtype=jnp.int32)
-            p._count_ub = sky.shape[0]
-            p._cap = cap
-            pend = z[f"pending_{pm['id']}"]
-            if pend.shape[0]:
-                p._pending = [pend]
-                p._pending_rows = pend.shape[0]
+        by_id = {pm["id"]: pm for pm in meta["partitions"]}
+        engine.pset.restore_all(
+            [z[f"sky_{p}"] for p in range(cfg.num_partitions)],
+            [z[f"pending_{p}"] for p in range(cfg.num_partitions)],
+        )
+        for pid, pm in by_id.items():
+            p = engine.partitions[pid]
             p.max_seen_id = pm["max_seen_id"]
             p.start_time_ms = pm["start_time_ms"]
-            p.processing_ns = pm["processing_ns"]
             p.records_seen = pm["records_seen"]
+        engine.pset.processing_ns = max(
+            (pm["processing_ns"] for pm in meta["partitions"]), default=0
+        )
 
         inflight_by_payload = {}
         for qm in meta["inflight"]:

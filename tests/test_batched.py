@@ -1,0 +1,81 @@
+"""PartitionSet (stacked, single-launch flush) semantics.
+
+The batched path must be result-identical to per-partition incremental
+merging: the merge law (SURVEY.md §4) makes the incremental skyline
+batching-invariant, so these tests pin the exact-set equality against the
+numpy oracle under uneven routing, heavy skew (multi-round flushes), and
+cache invalidation across flush/snapshot interleavings.
+"""
+
+import numpy as np
+
+from skyline_tpu.ops.dominance import skyline_np
+from skyline_tpu.stream.batched import PartitionSet
+from conftest import assert_same_set
+
+
+def test_uneven_partitions_match_oracle(rng):
+    ps = PartitionSet(num_partitions=4, dims=3, buffer_size=64)
+    data = [rng.uniform(0, 100, size=(n, 3)).astype(np.float32)
+            for n in (5, 700, 33, 0)]
+    for p, x in enumerate(data):
+        if x.shape[0]:
+            ps.add_batch(p, x, max_id=p, now_ms=0.0)
+    ps.maybe_flush()
+    for p, x in enumerate(data):
+        assert_same_set(ps.snapshot(p), skyline_np(x) if x.shape[0] else
+                        np.empty((0, 3)))
+
+
+def test_heavy_skew_multi_round_flush(rng):
+    """One partition holding many times buffer_size pending rows exercises
+    the multi-round loop inside flush_all."""
+    ps = PartitionSet(num_partitions=2, dims=2, buffer_size=1024)
+    x = rng.uniform(0, 1000, size=(5000, 2)).astype(np.float32)
+    ps.add_batch(0, x, max_id=0, now_ms=0.0)
+    ps.add_batch(1, x[:10], max_id=1, now_ms=0.0)
+    ps.flush_all()
+    assert_same_set(ps.snapshot(0), skyline_np(x))
+    assert_same_set(ps.snapshot(1), skyline_np(x[:10]))
+
+
+def test_snapshot_caches_invalidate_on_new_data(rng):
+    ps = PartitionSet(num_partitions=2, dims=2, buffer_size=16)
+    a = rng.uniform(0, 100, size=(50, 2)).astype(np.float32)
+    ps.add_batch(0, a, max_id=0, now_ms=0.0)
+    s1 = ps.snapshot(0)
+    assert_same_set(s1, skyline_np(a))
+    # a strictly better point must show up in the next snapshot
+    better = np.zeros((1, 2), dtype=np.float32)
+    ps.add_batch(0, better, max_id=1, now_ms=0.0)
+    s2 = ps.snapshot(0)
+    assert_same_set(s2, np.zeros((1, 2)))
+    # snapshot copies: mutating the returned array must not corrupt state
+    s2[:] = 123.0
+    assert_same_set(ps.snapshot(0), np.zeros((1, 2)))
+
+
+def test_incremental_equals_one_shot(rng):
+    """Stream in many small chunks == one big batch (batching invariance)."""
+    x = rng.uniform(0, 1000, size=(3000, 4)).astype(np.float32)
+    ps_stream = PartitionSet(num_partitions=1, dims=4, buffer_size=128)
+    for i in range(0, 3000, 77):
+        ps_stream.add_batch(0, x[i : i + 77], max_id=i, now_ms=0.0)
+        ps_stream.maybe_flush()
+    ps_one = PartitionSet(num_partitions=1, dims=4, buffer_size=4096)
+    ps_one.add_batch(0, x, max_id=0, now_ms=0.0)
+    assert_same_set(ps_stream.snapshot(0), ps_one.snapshot(0))
+    assert_same_set(ps_stream.snapshot(0), skyline_np(x))
+
+
+def test_counts_and_bookkeeping(rng):
+    ps = PartitionSet(num_partitions=3, dims=2, buffer_size=32)
+    x = rng.uniform(0, 100, size=(100, 2)).astype(np.float32)
+    ps.add_batch(1, x, max_id=41, now_ms=7.5)
+    assert ps.max_seen_id.tolist() == [-1, 41, -1]
+    assert ps.start_time_ms == [None, 7.5, None]
+    assert int(ps.records_seen[1]) == 100
+    ps.flush_all()
+    counts = ps.sky_counts()
+    assert counts[0] == 0 and counts[2] == 0
+    assert counts[1] == skyline_np(x).shape[0]
