@@ -1,6 +1,6 @@
 """Streaming layer: windowing, record-id query barrier, and the engine."""
 
-from skyline_tpu.stream.window import PartitionState
+from skyline_tpu.stream.batched import PartitionSet, PartitionView
 from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
 
-__all__ = ["PartitionState", "EngineConfig", "SkylineEngine"]
+__all__ = ["PartitionSet", "PartitionView", "EngineConfig", "SkylineEngine"]

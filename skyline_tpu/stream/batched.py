@@ -1,8 +1,8 @@
 """Batched partition-set state: all logical partitions' skylines in one
 stacked device buffer, merged in one launch.
 
-The per-partition model (``window.PartitionState``) dispatches 3 dominance
-kernels + a compact per partition per flush — ~P*4 launches per micro-batch.
+A per-partition state model dispatches 3 dominance kernels + a compact per
+partition per flush — ~P*4 launches per micro-batch.
 Through a dispatch-latency-bound link (the remote-TPU tunnel adds ~10s of ms
 per launch) that overhead dominates the actual VPU work by an order of
 magnitude. ``PartitionSet`` keeps the SAME semantics (per-partition
@@ -185,8 +185,8 @@ class PartitionSet:
     def snapshot(self, p: int) -> np.ndarray:
         """Flush pending rows and return partition ``p``'s local skyline
         (k, d) on host — the processQuery path (FlinkSkyline.java:367-403)."""
+        self.flush_all()  # times itself; t0 after it avoids double-counting
         t0 = time.perf_counter_ns()
-        self.flush_all()
         count = int(self.sky_counts()[p])
         out = self._host_sky()[p, :count].copy()
         self.processing_ns += time.perf_counter_ns() - t0
@@ -213,9 +213,15 @@ class PartitionSet:
         one host pass and one device upload.
 
         ``skies[p]`` rows are assumed mutually non-dominated (they came from
-        ``skyline_host``). Replaces all existing state.
+        ``skyline_host``). Replaces ALL existing state, including barrier and
+        metrics bookkeeping (reset to fresh; the caller re-applies saved
+        values, as ``utils.checkpoint.load_engine`` does).
         """
         assert len(skies) == len(pendings) == self.num_partitions
+        self.max_seen_id[:] = -1
+        self.start_time_ms = [None] * self.num_partitions
+        self.records_seen[:] = 0
+        self.processing_ns = 0
         counts = np.array([s.shape[0] for s in skies], dtype=np.int64)
         cap = _next_pow2(max(int(counts.max()), 1))
         svals = np.full(
@@ -247,16 +253,16 @@ class PartitionSet:
 
 
 class PartitionView:
-    """Per-partition facade over a ``PartitionSet`` with the same surface as
-    ``window.PartitionState`` — the engine and checkpointing address
-    partitions individually while storage stays stacked.
+    """Per-partition facade over a ``PartitionSet`` — the engine and
+    checkpointing address partitions individually while storage stays
+    stacked.
 
-    One deliberate contract delta vs ``PartitionState``: ``add_batch`` does
-    NOT auto-flush at the buffer threshold. Flush policy belongs to the set
-    (one batched launch for all partitions) — the owner must call
-    ``PartitionSet.maybe_flush()`` after routing a micro-batch, as
-    ``SkylineEngine.process_records`` does. ``snapshot`` still flushes, so
-    query results never miss pending rows either way."""
+    Contract note: ``add_batch`` does NOT auto-flush at the buffer
+    threshold. Flush policy belongs to the set (one batched launch for all
+    partitions) — the owner must call ``PartitionSet.maybe_flush()`` after
+    routing a micro-batch, as ``SkylineEngine.process_records`` does.
+    ``snapshot`` still flushes, so query results never miss pending rows
+    either way."""
 
     __slots__ = ("_set", "partition_id")
 

@@ -3,8 +3,9 @@
 One object replaces the reference's whole Flink job graph
 (FlinkSkyline.java:61-186): the ``keyBy`` shuffle becomes vectorized
 host-side partition-id routing; ``SkylineLocalProcessor`` becomes
-``PartitionState`` (per logical partition) with device-side incremental
-merges; the query broadcast flatMap (:145-157) becomes a loop over
+``PartitionSet`` (all logical partitions stacked on device, one batched
+merge launch per flush) addressed through per-partition ``PartitionView``
+facades; the query broadcast flatMap (:145-157) becomes a loop over
 partitions; and ``GlobalSkylineAggregator`` (:460-660) becomes a device-side
 union skyline with the same countdown-latch semantics, timing decomposition
 and optimality metric.

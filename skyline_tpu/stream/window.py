@@ -1,17 +1,18 @@
-"""Per-partition streaming window state with incremental skyline maintenance.
+"""Incremental windowed-merge kernels for streaming skyline maintenance.
 
-Mirrors the state model of the reference's ``SkylineLocalProcessor``
-(FlinkSkyline.java:214-445): a bounded input buffer that flushes into an
-incrementally-maintained local skyline, a max-seen record id for the query
-barrier, a first-arrival timestamp, and accumulated processing time. The BNL
-buffer-vs-skyline loop (:417-444) becomes one jitted masked dominance pass
-per flush.
+The merge step is the flush-time replacement for the reference's BNL
+buffer-vs-skyline loop (``SkylineLocalProcessor.processBuffer``,
+FlinkSkyline.java:417-444): one jitted masked dominance pass folds a new
+micro-batch into a running skyline buffer. The stateful owner of these
+kernels is ``skyline_tpu.stream.batched.PartitionSet``, which stacks all
+logical partitions and calls the *batched* variants — one device launch per
+flush for the whole set.
 
-TPU residency: the running skyline lives on device as a padded
-power-of-two-capacity buffer; each flush ships only the new micro-batch up
-and one scalar (the survivor count) back, so steady-state streaming never
-transfers the skyline itself. Capacities are bucketed so XLA compiles a
-bounded number of executables.
+TPU residency: running skylines live on device as padded
+power-of-two-capacity buffers; each flush ships only the new micro-batch up
+and survivor counts back, so steady-state streaming never transfers the
+skyline itself. Capacities are bucketed so XLA compiles a bounded number of
+executables.
 """
 
 from __future__ import annotations
@@ -96,135 +97,3 @@ _merge_step_pallas_batched = jax.jit(
     jax.vmap(_merge_step_pallas_core, in_axes=(0, 0, 0, 0, None)),
     static_argnames=("out_cap",),
 )
-
-
-class PartitionState:
-    """Host-side handle for one logical partition (of ``2 x parallelism``);
-    the skyline buffer itself is device-resident."""
-
-    def __init__(self, partition_id: int, dims: int, buffer_size: int = DEFAULT_BUFFER_SIZE):
-        self.partition_id = partition_id
-        self.dims = dims
-        self.buffer_size = buffer_size
-        # pending micro-batch rows awaiting a flush (list of (k, d) arrays)
-        self._pending: list[np.ndarray] = []
-        self._pending_rows = 0
-        # running local skyline: device buffer padded to a power-of-two cap
-        self._cap = _MIN_CAP
-        self.sky = jnp.full((self._cap, dims), jnp.inf, dtype=jnp.float32)
-        self.sky_valid = jnp.zeros((self._cap,), dtype=bool)
-        # survivor count: device scalar (exact, read lazily) + host upper
-        # bound (drives capacity growth WITHOUT a per-flush sync, so flushes
-        # dispatch asynchronously and partitions pipeline on the device)
-        self._count_dev = jnp.zeros((), dtype=jnp.int32)
-        self._count_ub = 0
-        # barrier + metrics bookkeeping (FlinkSkyline.java:243-248, 267)
-        self.max_seen_id: int = -1
-        self.start_time_ms: float | None = None
-        self.processing_ns: int = 0
-        self.records_seen: int = 0
-
-    # -- ingest -----------------------------------------------------------
-
-    def add_batch(self, values: np.ndarray, max_id: int, now_ms: float) -> None:
-        """Buffer a routed micro-batch; flush once the buffer threshold is hit."""
-        n = values.shape[0]
-        if n == 0:
-            return
-        if self.start_time_ms is None:
-            self.start_time_ms = now_ms
-        self.max_seen_id = max(self.max_seen_id, int(max_id))
-        self.records_seen += n
-        self._pending.append(values)
-        self._pending_rows += n
-        if self._pending_rows >= self.buffer_size:
-            self.flush()
-
-    def flush(self) -> None:
-        """Merge all pending rows into the running skyline (the processBuffer
-        equivalent, FlinkSkyline.java:417-444).
-
-        Batches are always padded to exactly ``buffer_size`` rows and the
-        output capacity only changes on power-of-two growth, so XLA compiles
-        at most two executables per capacity bucket over the engine's
-        lifetime (shape-bucketing discipline — dynamic sizes live on host).
-        """
-        if self._pending_rows == 0:
-            return
-        t0 = time.perf_counter_ns()
-        rows = (
-            self._pending[0]
-            if len(self._pending) == 1
-            else np.concatenate(self._pending, axis=0)
-        )
-        self._pending = []
-        self._pending_rows = 0
-
-        # round the flush batch up to a whole Pallas victim tile so the TPU
-        # fast path stays available for ANY buffer_size (e.g. the reference's
-        # 5000); the pad rows are synthesized below either way
-        B = -(-max(self.buffer_size, _MIN_CAP) // _MIN_CAP) * _MIN_CAP
-        for lo in range(0, rows.shape[0], B):
-            batch = rows[lo : lo + B]
-            bpad = np.full((B, self.dims), np.inf, dtype=np.float32)
-            bpad[: batch.shape[0]] = batch
-            bvalid = np.arange(B) < batch.shape[0]
-            # capacity growth from the host-side upper bound: may grow a
-            # bucket early when pruning was strong, never too late
-            out_cap = max(
-                self._cap, _next_pow2(self._count_ub + batch.shape[0])
-            )
-            if out_cap > self._cap:
-                # about to grow: tighten the bound with ONE real count sync
-                # (growth events are log-bounded, so steady-state flushes
-                # stay fully async; without this the bound accumulates every
-                # ingested row and capacity tracks stream size, not skyline
-                # size)
-                self._count_ub = self.sky_count
-                out_cap = max(
-                    self._cap, _next_pow2(self._count_ub + batch.shape[0])
-                )
-            # B is a _MIN_CAP multiple by construction and capacities are
-            # powers of two >= _MIN_CAP, so tile constraints always hold
-            merge = _merge_step_pallas if on_tpu() else _merge_step
-            self.sky, self.sky_valid, self._count_dev = merge(
-                self.sky,
-                self.sky_valid,
-                jnp.asarray(bpad),
-                jnp.asarray(bvalid),
-                out_cap,
-            )
-            self._cap = out_cap
-            self._count_ub = min(out_cap, self._count_ub + batch.shape[0])
-        self.processing_ns += time.perf_counter_ns() - t0
-
-    # -- query ------------------------------------------------------------
-
-    @property
-    def sky_count(self) -> int:
-        """Exact survivor count (forces one device sync; prefer at query /
-        checkpoint boundaries only)."""
-        count = int(self._count_dev)
-        self._count_ub = count
-        return count
-
-    def snapshot(self) -> np.ndarray:
-        """Flush pending rows and return the local skyline (k, d) on host —
-        the processQuery path (FlinkSkyline.java:367-403)."""
-        t0 = time.perf_counter_ns()
-        self.flush()
-        count = self.sky_count  # sync first, then transfer only count rows
-        out = np.asarray(self.sky[:count])
-        # the sync here absorbs all of this partition's in-flight flush work
-        self.processing_ns += time.perf_counter_ns() - t0
-        return out
-
-    def skyline_host(self) -> np.ndarray:
-        """Current device skyline pulled to host WITHOUT flushing pending
-        rows (checkpointing reads state as-is)."""
-        count = self.sky_count
-        return np.asarray(self.sky[:count])
-
-    @property
-    def processing_ms(self) -> float:
-        return self.processing_ns / 1e6

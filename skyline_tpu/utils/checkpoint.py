@@ -26,6 +26,9 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
     arrays: dict[str, np.ndarray] = {}
     meta = {
         "version": _FORMAT_VERSION,
+        # every EngineConfig field, so restore cannot silently revert a
+        # flag (e.g. query_timeout_ms=0 would resurrect the wait-forever
+        # latch the watchdog exists to prevent)
         "config": {
             "parallelism": cfg.parallelism,
             "algo": cfg.algo,
@@ -33,6 +36,9 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
             "dims": cfg.dims,
             "buffer_size": cfg.buffer_size,
             "emit_skyline_points": cfg.emit_skyline_points,
+            "merge_block": cfg.merge_block,
+            "query_timeout_ms": cfg.query_timeout_ms,
+            "grid_prefilter": cfg.grid_prefilter,
         },
         "records_in": engine.records_in,
         "dropped": engine.dropped,

@@ -54,17 +54,27 @@ def main(argv=None):
                          "(the reference's data-only kafka_producer.py variant)")
     ap.add_argument("--sink", choices=["kafka", "stdout"], default="kafka")
     ap.add_argument("--bootstrap", default="localhost:9092")
+    ap.add_argument("--start-id", type=int, default=0,
+                    help="first record id — resume a stream where a previous "
+                         "producer stopped (the reference always restarts at "
+                         "0, unified_producer.py:160, breaking barrier "
+                         "monotonicity on resume)")
+    ap.add_argument("--start-query-id", type=int, default=0)
     args = ap.parse_args(argv)
 
     send = _build_sink(args)
     rng = np.random.default_rng(args.seed)
-    record_id = 0
-    query_id = 0
-    next_trigger = args.query_threshold
-    next_progress = 100_000
+    record_id = args.start_id
+    query_id = args.start_query_id
+    qt = args.query_threshold
+    # next trigger fires at the next threshold multiple past start-id, so a
+    # resumed stream keeps the reference's every-QUERY_THRESHOLD cadence
+    next_trigger = (record_id // qt + 1) * qt if qt > 0 else 0
+    next_progress = (record_id // 100_000 + 1) * 100_000
 
-    while args.count == 0 or record_id < args.count:
-        n = args.batch if args.count == 0 else min(args.batch, args.count - record_id)
+    end_id = args.start_id + args.count
+    while args.count == 0 or record_id < end_id:
+        n = args.batch if args.count == 0 else min(args.batch, end_id - record_id)
         vals = generate(args.distribution, rng, n, args.dims, args.d_min, args.d_max)
         ids = np.arange(record_id, record_id + n, dtype=np.int64)
         # integer-valued floats print without trailing .0 via int cast

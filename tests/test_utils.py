@@ -100,3 +100,20 @@ def test_tracer_phases():
     assert rep["a"]["count"] == 2
     assert rep["b"]["count"] == 1
     assert rep["a"]["total_ms"] >= 0
+
+
+def test_checkpoint_preserves_all_config_flags(rng, tmp_path):
+    """Watchdog/prefilter flags must survive restore — a reverted
+    query_timeout_ms=0 would resurrect the reference's wait-forever latch."""
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    cfg = EngineConfig(parallelism=2, algo="mr-grid", dims=2,
+                       domain_max=100.0, query_timeout_ms=1234.5,
+                       grid_prefilter=True, merge_block=512)
+    eng = SkylineEngine(cfg)
+    x = rng.uniform(0, 100, size=(100, 2)).astype(np.float32)
+    eng.process_records(np.arange(100), x)
+    path = str(tmp_path / "ck.npz")
+    save_engine(eng, path)
+    restored = load_engine(path)
+    assert restored.config == cfg

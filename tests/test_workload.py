@@ -65,3 +65,22 @@ def test_qos_workload(rng):
     # dims truncation/extension
     assert generate("qos", rng, 100, 2, 0, 100).shape == (100, 2)
     assert generate("qos", rng, 100, 6, 0, 100).shape == (100, 6)
+
+
+def test_producer_resume_offsets(capsys):
+    """--start-id resumes the id sequence and keeps the every-threshold
+    trigger cadence aligned to the GLOBAL sequence (the reference's producer
+    always restarts at 0, unified_producer.py:160)."""
+    from skyline_tpu.workload.producer import main
+
+    main(["t", "uniform", "2", "0", "100", "q", "--sink", "stdout",
+          "--count", "30", "--batch", "10", "--seed", "1",
+          "--start-id", "95", "--query-threshold", "100",
+          "--start-query-id", "3"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    data = [l.split("\t")[1] for l in lines if l.startswith("t\t")]
+    trig = [l.split("\t")[1] for l in lines if l.startswith("q\t")]
+    ids = [int(l.split(",")[0]) for l in data]
+    assert ids == list(range(95, 125))
+    # one trigger at the id-100 threshold crossing, none at 200
+    assert trig == ["3,99"]
