@@ -132,3 +132,41 @@ def sharded_two_phase_skyline(
     """Convenience wrapper: build (cached) + run the two-phase step."""
     step = _cached_two_phase(mesh, axis, local_block, cross_block)
     return step(x, valid)
+
+
+def skyline_keep_np_sharded(
+    mesh: Mesh,
+    x: np.ndarray,
+    *,
+    axis: str | None = None,
+    local_block: int = 2048,
+    cross_block: int = 8192,
+) -> np.ndarray:
+    """Survivor mask of a host (n, d) array via the sharded two-phase step —
+    the mesh counterpart of ``ops.dispatch.skyline_keep_np``. Pads rows to a
+    power-of-two capacity (rounded to a mesh-size multiple), shards them
+    across the mesh, and slices the exact mask back. This is the engine's
+    global merge when it owns a mesh: the reference's single-reducer
+    bottleneck (pdf §5.5) as a parallel collective.
+
+    ``axis`` defaults to the mesh's first axis name, matching how
+    ``stream.batched.PartitionSet`` shards partition state."""
+    from skyline_tpu.utils.buckets import next_pow2
+
+    n, d = x.shape
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    if axis is None:
+        axis = mesh.axis_names[0]
+    n_dev = int(mesh.shape[axis])
+    cap = next_pow2(n, min_cap=1024)
+    cap = -(-cap // n_dev) * n_dev  # no-op for power-of-two mesh sizes
+    pad = np.full((cap, d), np.inf, dtype=np.float32)
+    pad[:n] = x
+    valid = np.arange(cap) < n
+    xs, vs = shard_rows(mesh, pad, valid, axis=axis)
+    _, global_keep = sharded_two_phase_skyline(
+        mesh, xs, vs, axis=axis, local_block=local_block,
+        cross_block=cross_block,
+    )
+    return np.asarray(global_keep)[:n]

@@ -44,8 +44,6 @@ class EngineConfig:
     dims: int = 2
     buffer_size: int = DEFAULT_BUFFER_SIZE
     emit_skyline_points: bool = False
-    # device block size for the global-merge skyline pass
-    merge_block: int = 2048
     # failure detection: a query whose barrier never clears on some partition
     # finalizes as a PARTIAL result after this long (0 = wait forever, the
     # reference's behavior — its countdown latch hangs if a partition never
@@ -90,13 +88,22 @@ class SkylineEngine:
     ``poll_results`` (each result is a dict with the reference's JSON fields).
     """
 
-    def __init__(self, config: EngineConfig):
+    def __init__(self, config: EngineConfig, mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` — logical partitions are
+        then sharded across its devices (local flushes run SPMD, one launch
+        for the whole set) and the global merge runs as the sharded
+        two-phase collective instead of a single-device kernel. ``None``
+        (default) runs everything on one chip. The mesh is a runtime
+        placement choice, not part of the query semantics, so it lives
+        outside ``EngineConfig`` (results are device-count invariant —
+        tests/test_mesh.py pins this)."""
         self.config = config
+        self.mesh = mesh
         # stacked device state: all partitions' skylines merge in ONE launch
         # per flush (see stream/batched.py); `partitions` are per-partition
         # facades over it
         self.pset = PartitionSet(
-            config.num_partitions, config.dims, config.buffer_size
+            config.num_partitions, config.dims, config.buffer_size, mesh=mesh
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
@@ -238,7 +245,12 @@ class SkylineEngine:
             else np.empty((0, self.config.dims), dtype=np.float32)
         )
 
-        keep = skyline_keep_np(union)
+        if self.mesh is not None:
+            from skyline_tpu.parallel.mesh import skyline_keep_np_sharded
+
+            keep = skyline_keep_np_sharded(self.mesh, union)
+        else:
+            keep = skyline_keep_np(union)
         global_sky = union[keep]
         survivors_per_pid = np.bincount(
             origins[keep], minlength=self.config.num_partitions

@@ -17,14 +17,10 @@ executables.
 
 from __future__ import annotations
 
-import time
-
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from skyline_tpu.ops.dominance import compact, dominated_by, skyline_mask
-from skyline_tpu.ops.dispatch import on_tpu
 from skyline_tpu.utils.buckets import next_pow2
 
 # Reference flushes its input buffer at 5000 tuples (BUFFER_SIZE,
@@ -81,10 +77,7 @@ def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     return compact(x, keep, out_cap)
 
 
-_merge_step = jax.jit(_merge_step_core, static_argnames=("out_cap",))
-_merge_step_pallas = jax.jit(_merge_step_pallas_core, static_argnames=("out_cap",))
-
-# Batched variants: merge P partitions' flushes in ONE device launch
+# Batched merge: P partitions' flushes in ONE device launch
 # (sky (P, cap, d), batch (P, B, d) -> (P, out_cap, d)). Streaming through a
 # dispatch-latency-bound link (the remote-TPU tunnel) is launch-count-bound,
 # so collapsing P per-partition merges into one vmapped executable is the

@@ -36,7 +36,6 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
             "dims": cfg.dims,
             "buffer_size": cfg.buffer_size,
             "emit_skyline_points": cfg.emit_skyline_points,
-            "merge_block": cfg.merge_block,
             "query_timeout_ms": cfg.query_timeout_ms,
             "grid_prefilter": cfg.grid_prefilter,
         },
@@ -86,14 +85,23 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 
 
-def load_engine(path: str) -> SkylineEngine:
-    """Restore an engine from a checkpoint written by ``save_engine``."""
+def load_engine(path: str, mesh=None) -> SkylineEngine:
+    """Restore an engine from a checkpoint written by ``save_engine``.
+
+    ``mesh`` re-applies a device-placement choice (it is runtime state, not
+    checkpoint state — an engine saved on one topology restores onto any)."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         if meta["version"] != _FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {meta['version']}")
-        cfg = EngineConfig(**meta["config"])
-        engine = SkylineEngine(cfg)
+        # tolerate fields added/removed across versions within format 1
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(EngineConfig)}
+        cfg = EngineConfig(
+            **{k: v for k, v in meta["config"].items() if k in known}
+        )
+        engine = SkylineEngine(cfg, mesh=mesh)
         engine.records_in = meta["records_in"]
         engine.dropped = meta["dropped"]
         engine._results = meta["results"]

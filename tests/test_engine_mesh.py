@@ -1,0 +1,85 @@
+"""Mesh-sharded streaming engine: same results as single-device, any mesh.
+
+The reference simulates distribution with a local mini-cluster (SURVEY.md §4
+item 5); here the SAME SkylineEngine runs its stacked partition state sharded
+over a virtual 8-device mesh — flushes SPMD, global merge as the sharded
+two-phase collective — and must be bit-identical on results to the
+single-device engine (device placement is not query semantics).
+"""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.parallel.mesh import make_mesh
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from conftest import assert_same_set
+
+
+def _run(cfg, mesh, x, chunks=5):
+    eng = SkylineEngine(cfg, mesh=mesh)
+    ids = np.arange(x.shape[0])
+    step = -(-x.shape[0] // chunks)
+    for i in range(0, x.shape[0], step):
+        eng.process_records(ids[i : i + step], x[i : i + step])
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    return r
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-grid", "mr-angle"])
+def test_meshed_engine_matches_single_device(rng, n_dev, algo):
+    cfg = EngineConfig(
+        parallelism=4, algo=algo, dims=3, domain_max=1000.0,
+        buffer_size=256, emit_skyline_points=True,
+    )
+    x = rng.uniform(0, 1000, size=(4000, 3)).astype(np.float32)
+    r_plain = _run(cfg, None, x)
+    r_mesh = _run(cfg, make_mesh(n_dev), x)
+    assert r_mesh["skyline_size"] == r_plain["skyline_size"]
+    assert r_mesh["optimality"] == pytest.approx(r_plain["optimality"])
+    assert_same_set(r_mesh["skyline_points"], r_plain["skyline_points"])
+
+
+def test_meshed_engine_rejects_indivisible_partitions():
+    cfg = EngineConfig(parallelism=3, dims=2)  # 6 partitions on 8 devices
+    with pytest.raises(ValueError, match="divisible"):
+        SkylineEngine(cfg, mesh=make_mesh(8))
+
+
+def test_checkpoint_across_topologies(rng, tmp_path):
+    """Save on a mesh, restore without one (and vice versa): placement is
+    runtime state, results must agree."""
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    cfg = EngineConfig(parallelism=4, algo="mr-angle", dims=2,
+                       domain_max=100.0, buffer_size=128)
+    x = rng.uniform(0, 100, size=(2000, 2)).astype(np.float32)
+    eng = SkylineEngine(cfg, mesh=make_mesh(8))
+    eng.process_records(np.arange(1000), x[:1000])
+    path = str(tmp_path / "ck.npz")
+    save_engine(eng, path)
+
+    restored = load_engine(path)  # no mesh
+    assert restored.mesh is None
+    for e in (eng, restored):
+        e.process_records(np.arange(1000, 2000), x[1000:])
+        e.process_trigger("0,0")
+    (r_mesh,) = eng.poll_results()
+    (r_plain,) = restored.poll_results()
+    assert r_mesh["skyline_size"] == r_plain["skyline_size"]
+
+
+def test_meshed_engine_custom_axis_name(rng):
+    """A mesh whose first axis is not named 'p' must work end to end
+    (ingest AND the query-time sharded global merge)."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("workers",))
+    cfg = EngineConfig(parallelism=4, algo="mr-grid", dims=2,
+                       domain_max=100.0, buffer_size=64)
+    x = rng.uniform(0, 100, size=(1500, 2)).astype(np.float32)
+    r_mesh = _run(cfg, mesh, x)
+    r_plain = _run(cfg, None, x)
+    assert r_mesh["skyline_size"] == r_plain["skyline_size"]

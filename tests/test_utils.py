@@ -109,7 +109,7 @@ def test_checkpoint_preserves_all_config_flags(rng, tmp_path):
 
     cfg = EngineConfig(parallelism=2, algo="mr-grid", dims=2,
                        domain_max=100.0, query_timeout_ms=1234.5,
-                       grid_prefilter=True, merge_block=512)
+                       grid_prefilter=True)
     eng = SkylineEngine(cfg)
     x = rng.uniform(0, 100, size=(100, 2)).astype(np.float32)
     eng.process_records(np.arange(100), x)
