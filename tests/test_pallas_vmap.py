@@ -1,0 +1,57 @@
+"""vmap lowering of the Pallas dominance kernels (interpret mode on CPU).
+
+The batched flush path (stream/batched.py) relies on ``jax.vmap`` of
+``pallas_call`` lifting the partition axis into a leading grid dimension.
+These tests pin that lowering against a per-item loop so a JAX upgrade or
+kernel change that breaks the batching rule fails here, on CPU, rather than
+on first TPU contact.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skyline_tpu.ops.pallas_dominance import (
+    dominated_by_any_pallas,
+    dominated_by_pallas,
+)
+from skyline_tpu.ops.dominance import skyline_np
+
+
+@pytest.fixture
+def batch(rng):
+    P, d, nx, ny = 4, 3, 512, 1024
+    xt = jnp.asarray(rng.uniform(0, 100, size=(P, d, nx)).astype(np.float32))
+    yt = jnp.asarray(rng.uniform(0, 100, size=(P, d, ny)).astype(np.float32))
+    xv = jnp.asarray(rng.random((P, nx)) < 0.8)
+    return xt, xv, yt
+
+
+def test_vmap_rectangular_matches_loop(batch):
+    xt, xv, yt = batch
+    f = jax.vmap(lambda a, v, b: dominated_by_pallas(a, v, b, interpret=True))
+    out = f(xt, xv, yt)
+    ref = jnp.stack(
+        [
+            dominated_by_pallas(xt[p], xv[p], yt[p], interpret=True)
+            for p in range(xt.shape[0])
+        ]
+    )
+    assert (out == ref).all()
+
+
+def test_vmap_self_dominance_matches_oracle(rng):
+    P, d, n = 3, 2, 1024
+    x = rng.uniform(0, 50, size=(P, n, d)).astype(np.float32)
+    f = jax.vmap(
+        lambda xt, v: dominated_by_any_pallas(xt, v, interpret=True)
+    )
+    dom = np.asarray(
+        f(jnp.asarray(np.swapaxes(x, 1, 2)), jnp.ones((P, n), dtype=bool))
+    )
+    for p in range(P):
+        keep = ~dom[p]
+        sky = skyline_np(x[p])
+        assert keep.sum() == sky.shape[0]
