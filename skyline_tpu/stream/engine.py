@@ -342,3 +342,31 @@ class SkylineEngine:
     @property
     def inflight_queries(self) -> int:
         return len(self._inflight)
+
+    # -- observability ----------------------------------------------------
+
+    def stats(self, include_skyline_counts: bool = False) -> dict:
+        """Live engine counters — the role the Flink Web UI plays for the
+        reference (SURVEY.md §5, docker-compose.yml:26), as a poll-able dict.
+
+        ``include_skyline_counts=True`` adds exact per-partition skyline
+        sizes at the cost of one device sync; leave False on hot paths.
+        """
+        out = {
+            "records_in": self.records_in,
+            "dropped": self.dropped,
+            "prefiltered": self.prefiltered,
+            "inflight_queries": len(self._inflight),
+            "pending_flush_rows": int(self.pset._pending_rows.sum()),
+            "processing_ms": self.pset.processing_ms,
+            "partitions": {
+                "records_seen": self.pset.records_seen.tolist(),
+                "max_seen_id": self.pset.max_seen_id.tolist(),
+            },
+            "meshed": self.mesh is not None,
+        }
+        if include_skyline_counts:
+            out["partitions"]["skyline_counts"] = (
+                self.pset.sky_counts().tolist()
+            )
+        return out

@@ -227,3 +227,21 @@ def test_grid_prefilter_waits_for_witness():
     eng.process_trigger("0,0")
     (r,) = eng.poll_results()
     assert r["skyline_size"] == 2  # both incomparable, both kept
+
+
+def test_stats_surface(rng):
+    eng = SkylineEngine(EngineConfig(parallelism=2, algo="mr-dim", dims=2,
+                                     domain_max=100.0, buffer_size=64))
+    x = rng.uniform(0, 100, size=(500, 2)).astype(np.float32)
+    eng.process_records(np.arange(500), x)
+    s = eng.stats(include_skyline_counts=True)
+    assert s["records_in"] == 500
+    assert sum(s["partitions"]["records_seen"]) == 500
+    assert s["inflight_queries"] == 0 and not s["meshed"]
+    # a 500-row batch over buffer_size=64 always triggers the set-wide
+    # flush, so nothing may remain pending
+    assert s["pending_flush_rows"] == 0
+    assert len(s["partitions"]["skyline_counts"]) == 4
+    eng.process_trigger("0,0")
+    eng.poll_results()
+    assert eng.stats()["inflight_queries"] == 0
