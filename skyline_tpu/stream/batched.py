@@ -60,10 +60,17 @@ class PartitionSet:
         dims: int,
         buffer_size: int = DEFAULT_BUFFER_SIZE,
         mesh=None,
+        initial_capacity: int = 0,
     ):
+        """``initial_capacity``: pre-size the per-partition skyline buffers
+        (rounded up to the power-of-two bucket). Capacity normally grows on
+        demand with one count sync per doubling; a workload that knows its
+        steady-state skyline size (e.g. repeated same-shape windows) can
+        pre-size to skip every growth step and its sync."""
         self.num_partitions = num_partitions
         self.dims = dims
         self.buffer_size = buffer_size
+        self.initial_capacity = initial_capacity
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -86,7 +93,7 @@ class PartitionSet:
         self._pending: list[list[np.ndarray]] = [[] for _ in range(p)]
         self._pending_rows = np.zeros(p, dtype=np.int64)
         # stacked running skylines: (P, cap, d) values + (P, cap) validity
-        self._cap = _MIN_CAP
+        self._cap = _next_pow2(max(initial_capacity, _MIN_CAP))
         self.sky = self._put(
             np.full((p, self._cap, dims), np.inf, dtype=np.float32)
         )
@@ -260,7 +267,12 @@ class PartitionSet:
         self.records_seen[:] = 0
         self.processing_ns = 0
         counts = np.array([s.shape[0] for s in skies], dtype=np.int64)
-        cap = _next_pow2(max(int(counts.max()), 1))
+        # honor the configured pre-sizing across restore, so a resumed
+        # engine keeps the growth-sync-free capacity the knob promises
+        cap = max(
+            _next_pow2(max(int(counts.max()), 1)),
+            _next_pow2(max(self.initial_capacity, _MIN_CAP)),
+        )
         svals = np.full(
             (self.num_partitions, cap, self.dims), np.inf, dtype=np.float32
         )

@@ -58,6 +58,9 @@ class EngineConfig:
     # dominates the dropped tuple). Barriers are unaffected: max-seen-id
     # advances before filtering.
     grid_prefilter: bool = False
+    # pre-size per-partition skyline buffers (0 = grow on demand); see
+    # PartitionSet.initial_capacity
+    initial_capacity: int = 0
 
     @property
     def num_partitions(self) -> int:
@@ -103,7 +106,11 @@ class SkylineEngine:
         # per flush (see stream/batched.py); `partitions` are per-partition
         # facades over it
         self.pset = PartitionSet(
-            config.num_partitions, config.dims, config.buffer_size, mesh=mesh
+            config.num_partitions,
+            config.dims,
+            config.buffer_size,
+            mesh=mesh,
+            initial_capacity=config.initial_capacity,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)

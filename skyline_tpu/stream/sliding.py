@@ -1,4 +1,4 @@
-"""Count-based sliding-window continuous skyline.
+"""Count-based sliding-window continuous skyline, device-resident.
 
 BASELINE.json config #4 ("sliding-window continuous skyline, count-based,
 high window overlap"). The reference has no eviction at all — its skyline is
@@ -9,23 +9,51 @@ Skyline under deletion is handled with the standard bucket decomposition: a
 window of W tuples sliding by S is K = W/S buckets; each bucket keeps the
 skyline of ITS OWN tuples (computed once, when the bucket closes), and the
 window skyline is the skyline of the union of the K bucket skylines — exact
-by the merge law (SURVEY.md §4). Eviction is then O(1): drop the oldest
-bucket, no re-examination of "resurrected" points is ever needed because
+by the merge law (SURVEY.md §4). Eviction is then O(1): overwrite the oldest
+ring slot, no re-examination of "resurrected" points is ever needed because
 bucket skylines never pruned across buckets.
 
-Per-slide cost: one bucket skyline (S points) + one union merge
-(sum of K bucket skyline sizes), both on-device.
+TPU shape: the K bucket skylines live on device as a ``(K, S_cap, d)`` ring
+(S_cap = the slide's power-of-two bucket — a bucket skyline can never exceed
+its bucket's row count, so the ring never grows). Each completed slide is ONE
+jitted launch: bucket-skyline the new rows, write the ring slot, window-
+skyline the masked union, and compact survivors — only the survivor rows and
+a count cross back to the host.
 """
 
 from __future__ import annotations
 
+import functools
 import time
-from collections import deque
 
-
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from skyline_tpu.ops.dispatch import skyline_of_np as _device_skyline
+from skyline_tpu.ops.block_skyline import skyline_mask_scan
+from skyline_tpu.ops.dominance import compact
+from skyline_tpu.ops.dispatch import skyline_of_np
+from skyline_tpu.utils.buckets import next_pow2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _slide_step(ring, ring_valid, slot, rows, rows_valid):
+    """One slide: close the new bucket into ``slot`` and window-merge.
+
+    ring (K, C, d), ring_valid (K, C), slot scalar int, rows (C, d) padded,
+    rows_valid (C,). Returns (ring, ring_valid, sky (K*C, d), sky_valid,
+    sky_count) with the window skyline compacted to the front of ``sky``.
+    """
+    k, c, d = ring.shape
+    bucket_keep = skyline_mask_scan(rows, rows_valid)
+    bvals, bvalid, _ = compact(rows, bucket_keep, c)
+    ring = ring.at[slot].set(bvals)
+    ring_valid = ring_valid.at[slot].set(bvalid)
+    flat = ring.reshape(k * c, d)
+    fvalid = ring_valid.reshape(k * c)
+    wkeep = skyline_mask_scan(flat, fvalid)
+    sky, sky_valid, count = compact(flat, wkeep, k * c)
+    return ring, ring_valid, sky, sky_valid, count
 
 
 class SlidingSkyline:
@@ -41,10 +69,17 @@ class SlidingSkyline:
         self.slide = slide
         self.dims = dims
         self.k = window_size // slide
-        self._buckets: deque[np.ndarray] = deque()  # per-bucket skylines
+        self._cap = next_pow2(slide, min_cap=128)
+        self._ring = jnp.full(
+            (self.k, self._cap, dims), jnp.inf, dtype=jnp.float32
+        )
+        self._ring_valid = jnp.zeros((self.k, self._cap), dtype=bool)
+        self._slot = 0
+        self._buckets_closed = 0
         self._pending: list[np.ndarray] = []
         self._pending_rows = 0
         self._tuples_seen = 0
+        self._last_sky: np.ndarray | None = None
         self.device_ns = 0
 
     def push(self, values: np.ndarray) -> list[dict]:
@@ -76,25 +111,44 @@ class SlidingSkyline:
         )
         self._pending = []
         self._pending_rows = 0
-        self._buckets.append(_device_skyline(rows, self.dims))
-        if len(self._buckets) > self.k:
-            self._buckets.popleft()  # O(1) eviction of the oldest bucket
-        union = np.concatenate(list(self._buckets), axis=0)
-        sky = _device_skyline(union, self.dims)
+        padded = np.full((self._cap, self.dims), np.inf, dtype=np.float32)
+        padded[: rows.shape[0]] = rows
+        rvalid = np.arange(self._cap) < rows.shape[0]
+        self._ring, self._ring_valid, sky, sky_valid, count = _slide_step(
+            self._ring,
+            self._ring_valid,
+            jnp.asarray(self._slot),  # traced: one executable for all slots
+            jnp.asarray(padded),
+            jnp.asarray(rvalid),
+        )
+        self._slot = (self._slot + 1) % self.k
+        self._buckets_closed += 1
+        c = int(count)  # one sync; transfer only the survivors below
+        result_sky = np.asarray(sky[:c])
+        self._last_sky = result_sky
         self.device_ns += time.perf_counter_ns() - t0
         return {
             "window_end": self._tuples_seen - 1,
-            "skyline": sky,
-            "window_filled": len(self._buckets) == self.k,
+            "skyline": result_sky,
+            "window_filled": self._buckets_closed >= self.k,
         }
 
     @property
     def current_skyline(self) -> np.ndarray:
         """Skyline over the current (possibly partial) window, including
         pending rows not yet forming a full slide."""
-        parts = list(self._buckets)
+        if not self._pending_rows and self._last_sky is not None:
+            # nothing changed since the last slide closed: its compacted
+            # window skyline is exactly current (no ring transfer needed)
+            return self._last_sky
+        ring = np.asarray(self._ring)
+        ring_valid = np.asarray(self._ring_valid)
+        parts = [
+            ring[s][ring_valid[s]]
+            for s in range(min(self._buckets_closed, self.k))
+        ]
         if self._pending_rows:
             parts.append(np.concatenate(self._pending, axis=0))
         if not parts:
             return np.empty((0, self.dims), dtype=np.float32)
-        return _device_skyline(np.concatenate(parts, axis=0), self.dims)
+        return skyline_of_np(np.concatenate(parts, axis=0), self.dims)

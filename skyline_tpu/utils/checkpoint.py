@@ -38,6 +38,7 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
             "emit_skyline_points": cfg.emit_skyline_points,
             "query_timeout_ms": cfg.query_timeout_ms,
             "grid_prefilter": cfg.grid_prefilter,
+            "initial_capacity": cfg.initial_capacity,
         },
         "records_in": engine.records_in,
         "dropped": engine.dropped,

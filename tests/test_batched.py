@@ -79,3 +79,15 @@ def test_counts_and_bookkeeping(rng):
     counts = ps.sky_counts()
     assert counts[0] == 0 and counts[2] == 0
     assert counts[1] == skyline_np(x).shape[0]
+
+
+def test_initial_capacity_presizing(rng):
+    """Pre-sized buffers skip growth and still produce exact skylines."""
+    x = rng.uniform(0, 1000, size=(2000, 3)).astype(np.float32)
+    ps = PartitionSet(num_partitions=2, dims=3, buffer_size=256,
+                      initial_capacity=4096)
+    assert ps._cap == 4096
+    ps.add_batch(0, x, max_id=0, now_ms=0.0)
+    ps.flush_all()
+    assert ps._cap == 4096  # no growth happened
+    assert_same_set(ps.snapshot(0), skyline_np(x))
