@@ -27,3 +27,31 @@ Subpackage map (reference parity noted per SURVEY.md §2):
 """
 
 __version__ = "0.1.0"
+
+
+def _honor_jax_platforms_env() -> None:
+    """Restore standard ``JAX_PLATFORMS`` semantics under plugin pinning.
+
+    Some TPU plugins import jax at interpreter startup and pin the platform
+    via ``jax.config``, which silently overrides a user's
+    ``JAX_PLATFORMS=cpu`` — scripts then hang on an unreachable device
+    instead of using the requested backend. If the env var is set, the
+    backend is not yet initialized, and the pinned config disagrees,
+    re-apply the env var (exactly what stock JAX would have done).
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        if not _xb._backends and jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:  # pragma: no cover - best effort, never block import
+        pass
+
+
+_honor_jax_platforms_env()
