@@ -24,6 +24,13 @@ Subpackage map (reference parity noted per SURVEY.md §2):
                   (python/metrics_collector.py; FlinkSkyline.java timing fields)
 - ``plots``     — figure tools (python/graph_*.py)
 - ``utils``     — config, padding/bucketing, checkpointing
+
+Import-time side effect: if ``JAX_PLATFORMS`` is set in the environment and
+the JAX backend is not yet initialized, importing this package re-applies the
+env var to ``jax.config`` (see ``_honor_jax_platforms_env``). This restores
+stock JAX semantics under TPU plugins that pin the platform at interpreter
+startup; embedding applications that manage ``jax.config`` themselves should
+unset ``JAX_PLATFORMS`` or initialize their backend before importing.
 """
 
 __version__ = "0.1.0"
@@ -48,10 +55,22 @@ def _honor_jax_platforms_env() -> None:
         import jax
         import jax._src.xla_bridge as _xb
 
-        if not _xb._backends and jax.config.jax_platforms != want:
-            jax.config.update("jax_platforms", want)
-    except Exception:  # pragma: no cover - best effort, never block import
-        pass
+        backend_live = bool(_xb._backends)
+    except (ImportError, AttributeError):
+        # a JAX-internal rename broke the probe: warn loudly instead of
+        # silently disabling the workaround
+        import warnings
+
+        warnings.warn(
+            "skyline_tpu: cannot probe JAX backend state "
+            "(jax._src.xla_bridge._backends moved?); JAX_PLATFORMS may be "
+            "ignored if a plugin pinned the platform",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return
+    if not backend_live and jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
 
 
 _honor_jax_platforms_env()

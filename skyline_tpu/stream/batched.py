@@ -39,6 +39,7 @@ from skyline_tpu.stream.window import (
     _merge_step_batched,
     _merge_step_pallas_batched,
     _next_pow2,
+    meshed_merge_step,
 )
 
 
@@ -195,16 +196,32 @@ class PartitionSet:
                 out_cap = max(
                     self._cap, _next_pow2(int((self._count_ub + widths).max()))
                 )
-            merge = (
-                _merge_step_pallas_batched if on_tpu() else _merge_step_batched
-            )
-            self.sky, self.sky_valid, self._count_dev = merge(
-                self.sky,
-                self.sky_valid,
-                self._put(batch),
-                self._put(bvalid),
-                out_cap,
-            )
+            if self.mesh is not None:
+                # explicit SPMD: pallas_call has no GSPMD partitioning rule,
+                # so the meshed flush must shard_map over the partition axis
+                # (each device merges only its resident partitions)
+                merge = meshed_merge_step(
+                    self.mesh, self.mesh.axis_names[0], on_tpu(), out_cap
+                )
+                self.sky, self.sky_valid, self._count_dev = merge(
+                    self.sky,
+                    self.sky_valid,
+                    self._put(batch),
+                    self._put(bvalid),
+                )
+            else:
+                merge = (
+                    _merge_step_pallas_batched
+                    if on_tpu()
+                    else _merge_step_batched
+                )
+                self.sky, self.sky_valid, self._count_dev = merge(
+                    self.sky,
+                    self.sky_valid,
+                    self._put(batch),
+                    self._put(bvalid),
+                    out_cap,
+                )
             self._cap = out_cap
             self._count_ub = np.minimum(out_cap, self._count_ub + widths)
         self._counts_cache = None

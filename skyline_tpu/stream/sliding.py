@@ -125,7 +125,9 @@ class SlidingSkyline:
         self._buckets_closed += 1
         c = int(count)  # one sync; transfer only the survivors below
         result_sky = np.asarray(sky[:c])
-        self._last_sky = result_sky
+        # private copy: the caller owns result_sky and may mutate it; the
+        # cache must stay pristine for current_skyline reads
+        self._last_sky = result_sky.copy()
         self.device_ns += time.perf_counter_ns() - t0
         return {
             "window_end": self._tuples_seen - 1,
@@ -139,8 +141,10 @@ class SlidingSkyline:
         pending rows not yet forming a full slide."""
         if not self._pending_rows and self._last_sky is not None:
             # nothing changed since the last slide closed: its compacted
-            # window skyline is exactly current (no ring transfer needed)
-            return self._last_sky
+            # window skyline is exactly current (no ring transfer needed);
+            # copy so callers can't corrupt the cache (PartitionSet.snapshot
+            # makes the same promise)
+            return self._last_sky.copy()
         ring = np.asarray(self._ring)
         ring_valid = np.asarray(self._ring_valid)
         parts = [

@@ -17,6 +17,9 @@ executables.
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -60,6 +63,15 @@ def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     return compact(x, keep, out_cap)
 
 
+def _pallas_interpret() -> bool:
+    """Read lazily (at trace time, not import time): set
+    ``SKYLINE_PALLAS_INTERPRET=1`` to run the Pallas merge in interpret mode
+    on CPU — how ``dryrun_multichip`` validates the shard_map-of-pallas_call
+    lowering without TPU hardware. Evaluated when a merge step first traces;
+    already-compiled executables are unaffected by later env changes."""
+    return os.environ.get("SKYLINE_PALLAS_INTERPRET", "") == "1"
+
+
 def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     """TPU fast path of ``_merge_step_core``: the three dominance passes run
     in the Pallas VMEM-tiled kernel (same mask logic, same transitivity
@@ -67,11 +79,18 @@ def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     _MIN_CAP floor and power-of-two bucketing guarantee that."""
     from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
 
+    interp = _pallas_interpret()
     sky_t = sky.T
     batch_t = batch.T
-    batch_local = batch_valid & ~dominated_by_pallas(batch_t, batch_valid, batch_t)
-    keep_batch = batch_local & ~dominated_by_pallas(sky_t, sky_valid, batch_t)
-    keep_sky = sky_valid & ~dominated_by_pallas(batch_t, keep_batch, sky_t)
+    batch_local = batch_valid & ~dominated_by_pallas(
+        batch_t, batch_valid, batch_t, interpret=interp
+    )
+    keep_batch = batch_local & ~dominated_by_pallas(
+        sky_t, sky_valid, batch_t, interpret=interp
+    )
+    keep_sky = sky_valid & ~dominated_by_pallas(
+        batch_t, keep_batch, sky_t, interpret=interp
+    )
     x = jnp.concatenate([sky, batch], axis=0)
     keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
     return compact(x, keep, out_cap)
@@ -90,3 +109,30 @@ _merge_step_pallas_batched = jax.jit(
     jax.vmap(_merge_step_pallas_core, in_axes=(0, 0, 0, 0, None)),
     static_argnames=("out_cap",),
 )
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_merge_step(mesh, axis: str, use_pallas: bool, out_cap: int):
+    """Batched merge wrapped in ``shard_map`` over the partition axis.
+
+    With partition state sharded ``(P, cap, d)`` across a mesh, the plain
+    jitted vmap relies on GSPMD auto-partitioning — fine for the XLA merge,
+    but ``pallas_call`` has no partitioning rule, so the Pallas variant must
+    be explicitly SPMD: each device runs the vmapped merge on its resident
+    partitions (the merge has no cross-partition data flow, so no
+    collectives are needed). Cached per (mesh, axis, kernel, capacity
+    bucket) so steady-state flushes reuse one executable.
+    """
+    from jax.sharding import PartitionSpec
+
+    core = _merge_step_pallas_core if use_pallas else _merge_step_core
+    vm = jax.vmap(lambda s, sv, b, bv: core(s, sv, b, bv, out_cap))
+    spec = PartitionSpec(axis)
+    sharded = jax.shard_map(
+        vm,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

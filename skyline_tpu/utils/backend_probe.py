@@ -1,0 +1,78 @@
+"""Subprocess probe of the real JAX backend — shared by bench.py and
+__graft_entry__.dryrun_multichip.
+
+The environment's TPU plugin can hang indefinitely inside backend init when
+its tunnel is unreachable (the round-1 bench failure, BENCH_r01.json): a
+bare ``jax.devices()`` then blocks with no timeout. Probing in a subprocess
+bounds the hang; retries with backoff give a flaky tunnel a chance to
+recover. ``JAX_PLATFORMS`` is stripped from the probe's environment so it
+reports what STOCK platform resolution would pick — callers decide
+separately whether a user-pinned platform overrides the probe (bench.py
+treats ``JAX_PLATFORMS=cpu`` as forcing the CPU path).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+
+_PROBE_SRC = (
+    "import json, jax; "
+    "print(json.dumps({'backend': jax.default_backend(),"
+    " 'n_devices': len(jax.devices())}))"
+)
+
+
+def probe_backend(
+    timeout_s: float, attempts: int = 1, backoff_s: float = 0.0
+) -> dict:
+    """Returns ``{"backend": str|None, "n_devices": int, "attempts": int,
+    "errors": [str], "probe_s": float}``; ``backend`` is None if every
+    attempt failed or timed out."""
+    import os
+
+    diag: dict = {"attempts": 0, "errors": [], "n_devices": 0}
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    for i in range(attempts):
+        diag["attempts"] = i + 1
+        t0 = time.time()
+        err = None
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+            )
+            if r.returncode == 0:
+                try:
+                    info = json.loads(r.stdout.strip().splitlines()[-1])
+                    diag.update(info)
+                    diag["probe_s"] = round(time.time() - t0, 1)
+                    return diag
+                except (ValueError, IndexError):
+                    err = (
+                        f"probe attempt {i + 1}: unparseable output "
+                        f"{r.stdout[-200:]!r}"
+                    )
+            else:
+                err = (
+                    f"probe attempt {i + 1}: rc={r.returncode}: "
+                    f"{(r.stderr or '')[-400:]}"
+                )
+        except subprocess.TimeoutExpired:
+            err = (
+                f"probe attempt {i + 1}: timed out after {timeout_s:.0f}s "
+                "(backend init hang)"
+            )
+        except OSError as e:
+            err = f"probe attempt {i + 1}: {e}"
+        diag["errors"].append(err)
+        if i + 1 < attempts:
+            time.sleep(backoff_s)
+    diag["backend"] = None
+    return diag

@@ -102,11 +102,53 @@ def qos(rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float)
     return np.clip(np.trunc(np.stack(cols, axis=1)), d_min, d_max).astype(np.float32)
 
 
+def simple_correlated(
+    rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float
+):
+    """P2's distinct correlated math (kafka_producer.py:58-64): INTEGER base
+    in [d_min, d_max], per-dimension INTEGER offsets in ±10% of the domain,
+    clamped — vs the unified producer's float base ± (1-rho)-scaled float
+    noise. The offset window happens to coincide at rho=0.9, but the integer
+    lattice and inclusive-bound sampling are P2's own."""
+    offset = int((d_max - d_min) * 0.1)
+    base = rng.integers(int(d_min), int(d_max) + 1, size=(n, 1))
+    noise = rng.integers(-offset, offset + 1, size=(n, dims))
+    return np.clip(base + noise, d_min, d_max).astype(np.float32)
+
+
+def simple_anti_correlated(
+    rng: np.random.Generator, n: int, dims: int, d_min: float, d_max: float
+):
+    """P2's anti-correlated (kafka_producer.py:77-88): every point scaled so
+    its coordinate sum lands EXACTLY on the hypercube-center plane (no
+    epsilon thickness band, unlike unified_producer.py:92-102) — a strictly
+    harder skyline workload at d >= 4, where the unified band (eps 0.9) is
+    wide enough to dilute the anti-correlation."""
+    vals = rng.random(size=(n, dims))
+    total = vals.sum(axis=1, keepdims=True)
+    total = np.where(total == 0, 1.0, total)
+    target = (d_min + d_max) / 2.0 * dims
+    return np.clip(np.trunc(vals * (target / total)), d_min, d_max).astype(
+        np.float32
+    )
+
+
 GENERATORS = {
     "uniform": uniform,
     "correlated": correlated,
     "anti_correlated": anti_correlated,
     "qos": qos,
+    "simple_correlated": simple_correlated,
+    "simple_anti_correlated": simple_anti_correlated,
+}
+
+# P2 (kafka_producer.py) shares P1's uniform math but has its own
+# correlated / anti-correlated formulas; ``--variant simple`` maps the
+# common CLI names onto them.
+SIMPLE_VARIANT = {
+    "uniform": "uniform",
+    "correlated": "simple_correlated",
+    "anti_correlated": "simple_anti_correlated",
 }
 
 

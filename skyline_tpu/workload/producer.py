@@ -18,7 +18,11 @@ import sys
 import numpy as np
 
 from skyline_tpu.bridge.wire import format_trigger
-from skyline_tpu.workload.generators import QUERY_THRESHOLD, generate
+from skyline_tpu.workload.generators import (
+    QUERY_THRESHOLD,
+    SIMPLE_VARIANT,
+    generate,
+)
 
 
 def _build_sink(args):
@@ -52,6 +56,10 @@ def main(argv=None):
     ap.add_argument("--query-threshold", type=int, default=QUERY_THRESHOLD,
                     help="records per injected trigger; <= 0 disables triggers "
                          "(the reference's data-only kafka_producer.py variant)")
+    ap.add_argument("--variant", choices=["unified", "simple"], default="unified",
+                    help="generator math: 'unified' = unified_producer.py:50-123; "
+                         "'simple' = kafka_producer.py:58-88's distinct "
+                         "correlated/anti-correlated formulas (P2 parity)")
     ap.add_argument("--sink", choices=["kafka", "stdout"], default="kafka")
     ap.add_argument("--bootstrap", default="localhost:9092")
     ap.add_argument("--start-id", type=int, default=0,
@@ -63,6 +71,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     send = _build_sink(args)
+    distribution = args.distribution
+    if args.variant == "simple":
+        key = distribution.lower().replace("-", "_")
+        distribution = SIMPLE_VARIANT.get(key, distribution)
     rng = np.random.default_rng(args.seed)
     record_id = args.start_id
     query_id = args.start_query_id
@@ -75,7 +87,7 @@ def main(argv=None):
     end_id = args.start_id + args.count
     while args.count == 0 or record_id < end_id:
         n = args.batch if args.count == 0 else min(args.batch, end_id - record_id)
-        vals = generate(args.distribution, rng, n, args.dims, args.d_min, args.d_max)
+        vals = generate(distribution, rng, n, args.dims, args.d_min, args.d_max)
         ids = np.arange(record_id, record_id + n, dtype=np.int64)
         # integer-valued floats print without trailing .0 via int cast
         lines = [

@@ -84,3 +84,53 @@ def test_producer_resume_offsets(capsys):
     assert ids == list(range(95, 125))
     # one trigger at the id-100 threshold crossing, none at 200
     assert trig == ["3,99"]
+
+
+def test_simple_variant_distribution_signatures(rng):
+    """P2's generators (kafka_producer.py:58-88) are DIFFERENT distributions
+    from P1's: the simple anti-correlated pins every point's coordinate sum
+    exactly to the center plane (no epsilon band), so at d=4 (where P1's
+    band is eps=0.9, wide enough to dilute the anti-correlation) its sum
+    spread collapses and its skyline signature differs."""
+    from skyline_tpu.ops.dominance import skyline_np
+    from skyline_tpu.workload.generators import (
+        anti_correlated,
+        simple_anti_correlated,
+        simple_correlated,
+    )
+
+    n, d = 20000, 4
+    p1 = anti_correlated(rng, n, d, 0, 10000)
+    p2 = simple_anti_correlated(rng, n, d, 0, 10000)
+    # sum spread: P2 sums sit on the plane (truncation/clipping error only),
+    # P1's d=4 band is tens of thousands wide
+    assert p2.sum(axis=1).std() * 10 < p1.sum(axis=1).std()
+    # skyline-size signature differs: exact anti-correlation keeps far more
+    # mutually non-dominated points than the diluted band
+    s1 = skyline_np(p1[:5000]).shape[0]
+    s2 = skyline_np(p2[:5000]).shape[0]
+    assert s2 > 2 * s1
+
+    # simple correlated: integer lattice, rows confined to base ± 10% domain
+    c = simple_correlated(rng, n, d, 0, 10000)
+    assert np.all(c == np.trunc(c))
+    spread = c.max(axis=1) - c.min(axis=1)
+    assert spread.max() <= 2 * 1000
+    assert (0 <= c).all() and (c <= 10000).all()
+
+
+def test_producer_variant_simple(capsys):
+    """--variant simple routes the CLI distribution names onto P2's math."""
+    from skyline_tpu.workload.producer import main
+
+    main(["t", "anti-correlated", "4", "0", "10000", "q", "--sink", "stdout",
+          "--count", "2000", "--batch", "500", "--seed", "7",
+          "--query-threshold", "0", "--variant", "simple"])
+    out = capsys.readouterr().out
+    rows = np.array(
+        [[float(v) for v in l.split("\t")[1].split(",")[1:]]
+         for l in out.splitlines() if l.startswith("t\t")]
+    )
+    assert rows.shape == (2000, 4)
+    # exact center-plane sums (20000) up to truncation/clip slack
+    assert abs(np.median(rows.sum(axis=1)) - 20000) < 100
