@@ -53,6 +53,11 @@ def _honor_jax_platforms_env() -> None:
         return
     try:
         import jax
+    except ImportError:
+        # no jax at all (a transport-only role, e.g. the kafkalite broker
+        # CLI on a harness host): nothing to repair, nothing to warn about
+        return
+    try:
         import jax._src.xla_bridge as _xb
 
         backend_live = bool(_xb._backends)

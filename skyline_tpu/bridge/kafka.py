@@ -1,4 +1,4 @@
-"""Kafka transport (gated): the production bridge onto the reference's topics.
+"""Kafka transport: the production bridge onto the reference's topics.
 
 Mirrors the reference's Kafka wiring exactly — bootstrap ``localhost:9092``,
 data topic consumed from earliest, query topic from latest, 10 MB max request
@@ -6,9 +6,12 @@ size on the result producer (FlinkSkyline.java:84-97, 177-183;
 docker-setup/docker-compose.yml:20-21) — so the reference's own Python
 harness (producers, collector) works unchanged against this engine.
 
-``kafka-python`` is not part of the baked image; everything here raises a
-clear error at construction time if it is missing, and the rest of the
-framework (MemoryBus path) never imports it.
+Backend selection: kafka-python when installed (a real JVM broker
+deployment), otherwise the bundled pure-Python ``kafkalite`` client, which
+speaks the same wire protocol (RecordBatch v2, Produce/Fetch/Metadata/
+ListOffsets) against either a real broker or the embedded
+``kafkalite.Broker``. Both paths expose the same produce/consumer surface
+as ``MemoryBus``.
 """
 
 from __future__ import annotations
@@ -21,34 +24,36 @@ try:  # pragma: no cover - exercised only where kafka-python is installed
     from kafka import KafkaProducer as _KafkaProducer
 
     HAVE_KAFKA = True
-except ImportError:  # pragma: no cover
+except ImportError:
     _KafkaConsumer = None
     _KafkaProducer = None
     HAVE_KAFKA = False
 
 
-def _require_kafka():
-    if not HAVE_KAFKA:
-        raise RuntimeError(
-            "kafka-python is not installed; use skyline_tpu.bridge.memory.MemoryBus "
-            "for in-process runs, or install kafka-python for a real broker"
-        )
-
-
 class KafkaBus:
-    """Same produce/consumer surface as MemoryBus, backed by a real broker."""
+    """Same produce/consumer surface as MemoryBus, backed by a real broker
+    over the Kafka wire protocol (kafka-python or bundled kafkalite)."""
 
     def __init__(self, bootstrap: str = DEFAULT_BOOTSTRAP):
-        _require_kafka()
         self.bootstrap = bootstrap
-        self._producer = _KafkaProducer(
-            bootstrap_servers=bootstrap,
-            value_serializer=lambda s: s.encode("utf-8"),
-            max_request_size=MAX_REQUEST_SIZE,
-        )
+        if HAVE_KAFKA:  # pragma: no cover - not in the baked image
+            self._producer = _KafkaProducer(
+                bootstrap_servers=bootstrap,
+                value_serializer=lambda s: s.encode("utf-8"),
+                max_request_size=MAX_REQUEST_SIZE,
+            )
+            self._lite = False
+        else:
+            from skyline_tpu.bridge.kafkalite import KafkaLiteProducer
+
+            self._producer = KafkaLiteProducer(
+                bootstrap, max_request_size=MAX_REQUEST_SIZE
+            )
+            self._lite = True
 
     def produce(self, topic: str, message: str) -> None:
         self._producer.send(topic, message)
+        self._producer.flush()
 
     def produce_many(self, topic: str, messages) -> None:
         for m in messages:
@@ -56,17 +61,24 @@ class KafkaBus:
         self._producer.flush()
 
     def consumer(self, topic: str, from_beginning: bool = True):
-        _require_kafka()
-        c = _KafkaConsumer(
-            topic,
-            bootstrap_servers=self.bootstrap,
-            auto_offset_reset="earliest" if from_beginning else "latest",
-            value_deserializer=lambda b: b.decode("utf-8"),
-        )
-        return _KafkaConsumerAdapter(c)
+        reset = "earliest" if from_beginning else "latest"
+        if HAVE_KAFKA:  # pragma: no cover - not in the baked image
+            c = _KafkaConsumer(
+                topic,
+                bootstrap_servers=self.bootstrap,
+                auto_offset_reset=reset,
+                value_deserializer=lambda b: b.decode("utf-8"),
+            )
+            return _KafkaConsumerAdapter(c)
+        from skyline_tpu.bridge.kafkalite import KafkaLiteConsumer
+
+        return KafkaLiteConsumer(topic, self.bootstrap, auto_offset_reset=reset)
+
+    def close(self) -> None:
+        self._producer.close()
 
 
-class _KafkaConsumerAdapter:
+class _KafkaConsumerAdapter:  # pragma: no cover - kafka-python only
     def __init__(self, consumer):
         self._consumer = consumer
         self.topic = next(iter(consumer.subscription()), None)

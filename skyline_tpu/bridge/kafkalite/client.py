@@ -1,0 +1,285 @@
+"""Pure-Python Kafka producer/consumer over the real wire protocol.
+
+The client half of kafkalite (see protocol.py): enough of a Kafka client
+to run the reference's data plane — value-only string messages on
+single-partition topics, earliest/latest offset reset, client-side
+``max_request_size`` enforcement mirroring kafka-python's (and the
+reference result sink's ``max.request.size=10485760``,
+FlinkSkyline.java:177-183). Talks to any broker supporting the
+non-flexible api versions in protocol.py: the embedded ``broker.Broker``
+or a real Kafka <= 3.x.
+
+Partitioning: all records go to partition 0. The reference's topics are
+single-partition (docker-compose auto-creation defaults), and the engine
+does its own spatial partitioning downstream — Kafka partitions were never
+the parallelism mechanism in this system (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from skyline_tpu.bridge.kafkalite import protocol as P
+
+
+class KafkaLiteError(Exception):
+    pass
+
+
+class MessageSizeTooLargeError(KafkaLiteError):
+    pass
+
+
+class _Connection:
+    """One framed request/response socket with correlation-id matching."""
+
+    def __init__(self, bootstrap: str, client_id: str, timeout_s: float = 30.0):
+        host, _, port = bootstrap.partition(":")
+        self._sock = socket.create_connection(
+            (host, int(port or 9092)), timeout=timeout_s
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.client_id = client_id
+        self._corr = 0
+        self._lock = threading.Lock()
+
+    def request(self, api_key: int, api_version: int, body: bytes) -> P.Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            self._sock.sendall(
+                P.encode_request(api_key, api_version, corr, self.client_id, body)
+            )
+            frame = P.read_frame(self._sock)
+            if frame is None:
+                raise KafkaLiteError("broker closed connection")
+            r = P.Reader(frame)
+            got = r.int32()
+            if got != corr:
+                raise KafkaLiteError(f"correlation mismatch {got} != {corr}")
+            return r
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class KafkaLiteProducer:
+    """Batching producer: ``send`` buffers, ``flush`` ships one Produce
+    request per topic (one RecordBatch v2 per partition)."""
+
+    def __init__(
+        self,
+        bootstrap: str,
+        max_request_size: int = 10_485_760,
+        linger_records: int = 4096,
+        client_id: str = "kafkalite-producer",
+    ):
+        self._conn = _Connection(bootstrap, client_id)
+        self.max_request_size = max_request_size
+        self.linger_records = linger_records
+        self._buf: dict[str, list[bytes]] = {}
+        self._lock = threading.Lock()
+
+    def send(self, topic: str, value: str | bytes) -> None:
+        v = value.encode("utf-8") if isinstance(value, str) else value
+        if len(v) > self.max_request_size:
+            raise MessageSizeTooLargeError(
+                f"{len(v)} bytes > max_request_size {self.max_request_size}"
+            )
+        with self._lock:
+            self._buf.setdefault(topic, []).append(v)
+            should_flush = len(self._buf[topic]) >= self.linger_records
+        if should_flush:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            buf, self._buf = self._buf, {}
+        pending = dict(buf)  # un-sent topics restored if a send fails
+        try:
+            self._flush_topics(buf, pending)
+        except Exception:
+            # put every unacked record back so a caller catching the error
+            # can retry flush() without losing data (kafka-python keeps
+            # unacked batches across transient faults too)
+            with self._lock:
+                for topic, values in pending.items():
+                    self._buf.setdefault(topic, [])[:0] = values
+            raise
+
+    def _flush_topics(self, buf: dict, pending: dict) -> None:
+        for topic, values in buf.items():
+            if not values:
+                pending.pop(topic, None)
+                continue
+            batch = P.encode_record_batch(
+                [(None, v) for v in values],
+                base_timestamp=int(time.time() * 1000),
+            )
+            if len(batch) > self.max_request_size:
+                # not retryable as-is: restoring would wedge every retry
+                pending.pop(topic, None)
+                raise MessageSizeTooLargeError(
+                    f"batch of {len(values)} records is {len(batch)} bytes "
+                    f"> max_request_size {self.max_request_size}"
+                )
+            body = (
+                P.Writer()
+                .string(None)  # transactional_id
+                .int16(1)  # acks
+                .int32(30_000)  # timeout_ms
+                .array(
+                    [(topic, batch)],
+                    lambda w, t: w.string(t[0]).array(
+                        [(0, t[1])],
+                        lambda w, p: w.int32(p[0]).bytes_(p[1]),
+                    ),
+                )
+                .build()
+            )
+            r = self._conn.request(P.API_PRODUCE, 3, body)
+
+            def read_pr(rr: P.Reader):
+                part = rr.int32()
+                err = rr.int16()
+                base = rr.int64()
+                rr.int64()  # log_append_time
+                return part, err, base
+
+            responses = r.array(
+                lambda rr: (rr.string(), rr.array(read_pr))
+            )
+            for _name, prs in responses or []:
+                for _part, err, _base in prs or []:
+                    if err == P.ERR_MESSAGE_TOO_LARGE:
+                        # acked as failed: do NOT restore (a too-large batch
+                        # would wedge every retry); drop it like kafka-python
+                        pending.pop(topic, None)
+                        raise MessageSizeTooLargeError(
+                            f"broker rejected batch for {topic}: message too large"
+                        )
+                    if err != P.ERR_NONE:
+                        raise KafkaLiteError(
+                            f"produce to {topic} failed: error {err}"
+                        )
+            pending.pop(topic, None)  # acked: nothing to restore for this topic
+
+    def close(self) -> None:
+        self.flush()
+        self._conn.close()
+
+
+class KafkaLiteConsumer:
+    """Single-topic, partition-0 consumer with earliest/latest reset."""
+
+    def __init__(
+        self,
+        topic: str,
+        bootstrap: str,
+        auto_offset_reset: str = "earliest",
+        client_id: str = "kafkalite-consumer",
+        fetch_max_bytes: int = 16 * 1024 * 1024,
+    ):
+        self.topic = topic
+        self._conn = _Connection(bootstrap, client_id)
+        self._reset = auto_offset_reset
+        self._offset: int | None = None
+        self.fetch_max_bytes = fetch_max_bytes
+        # Metadata request auto-creates the topic on the embedded broker,
+        # matching the reference's auto-create reliance
+        self._conn.request(
+            P.API_METADATA,
+            1,
+            P.Writer().array([topic], lambda w, t: w.string(t)).build(),
+        )
+        # resolve the reset position NOW: a latest-reset consumer must skip
+        # only what predates its subscription, not what predates its first
+        # poll (the reference's query consumer relies on this,
+        # FlinkSkyline.java:92-97)
+        self._position()
+
+    def _position(self) -> int:
+        if self._offset is None:
+            ts = P.TS_EARLIEST if self._reset == "earliest" else P.TS_LATEST
+            body = (
+                P.Writer()
+                .int32(-1)  # replica_id
+                .array(
+                    [(self.topic, [(0, ts)])],
+                    lambda w, t: w.string(t[0]).array(
+                        t[1], lambda w, p: w.int32(p[0]).int64(p[1])
+                    ),
+                )
+                .build()
+            )
+            r = self._conn.request(P.API_LIST_OFFSETS, 1, body)
+
+            def read_pr(rr: P.Reader):
+                return rr.int32(), rr.int16(), rr.int64(), rr.int64()
+
+            responses = r.array(lambda rr: (rr.string(), rr.array(read_pr)))
+            offset = 0
+            for _name, prs in responses or []:
+                for _part, err, _ts, off in prs or []:
+                    if err != P.ERR_NONE:
+                        raise KafkaLiteError(f"list_offsets error {err}")
+                    offset = off
+            self._offset = offset
+        return self._offset
+
+    def poll(
+        self, max_records: int = 65536, timeout_ms: int = 100
+    ) -> list[str]:
+        offset = self._position()
+        body = (
+            P.Writer()
+            .int32(-1)  # replica_id
+            .int32(timeout_ms)  # max_wait
+            .int32(1)  # min_bytes
+            .int32(self.fetch_max_bytes)
+            .int8(0)  # isolation_level
+            .array(
+                [(self.topic, [(0, offset, self.fetch_max_bytes)])],
+                lambda w, t: w.string(t[0]).array(
+                    t[1],
+                    lambda w, p: w.int32(p[0]).int64(p[1]).int32(p[2]),
+                ),
+            )
+            .build()
+        )
+        r = self._conn.request(P.API_FETCH, 4, body)
+        r.int32()  # throttle_time_ms
+
+        def read_pr(rr: P.Reader):
+            part = rr.int32()
+            err = rr.int16()
+            hw = rr.int64()
+            rr.int64()  # last_stable_offset
+            rr.array(lambda a: (a.int64(), a.int64()))  # aborted txns
+            blob = rr.bytes_() or b""
+            return part, err, hw, blob
+
+        responses = r.array(lambda rr: (rr.string(), rr.array(read_pr)))
+        out: list[str] = []
+        for _name, prs in responses or []:
+            for _part, err, hw, blob in prs or []:
+                if err == P.ERR_OFFSET_OUT_OF_RANGE:
+                    # log truncated/reset under us: re-resolve and retry next poll
+                    self._offset = None
+                    continue
+                if err != P.ERR_NONE:
+                    raise KafkaLiteError(f"fetch error {err}")
+                for abs_off, _key, value in P.decode_record_batches(blob):
+                    if abs_off < offset or len(out) >= max_records:
+                        continue
+                    out.append((value or b"").decode("utf-8"))
+                    self._offset = abs_off + 1
+        return out
+
+    def close(self) -> None:
+        self._conn.close()

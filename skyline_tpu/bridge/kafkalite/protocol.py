@@ -1,0 +1,380 @@
+"""Kafka wire-protocol primitives (pure Python, no dependencies).
+
+Implements the subset of the REAL Kafka protocol needed for a
+producer/consumer data plane — the same wire format Kafka 3.7 brokers
+(the reference's docker-setup pin, docker-compose.yml:4) speak:
+
+- request/response framing: ``int32 size`` + header
+  (``api_key int16, api_version int16, correlation_id int32,
+  client_id nullable-string``)
+- primitive codecs: big-endian ints, (nullable) strings, (nullable) bytes,
+  arrays, zigzag varints/varlongs
+- **RecordBatch v2** (magic=2) encode/decode, including the CRC32C
+  checksum over attributes..end — the current on-disk/on-wire record
+  format (KIP-98). Compression attributes are not implemented (codec 0
+  only), matching the reference harness which never enables compression.
+
+Only NON-FLEXIBLE api versions are used by kafkalite (flexible versions
+add tagged fields + compact encodings): Produce v3, Fetch v4, Metadata v1,
+ListOffsets v1, ApiVersions v0. A real broker accepts all of these, and a
+real modern client can talk to the embedded broker after ApiVersions
+negotiation caps it to the same set.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# api keys (the Kafka protocol's stable ids)
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_API_VERSIONS = 18
+
+# error codes
+ERR_NONE = 0
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
+ERR_MESSAGE_TOO_LARGE = 10
+ERR_UNSUPPORTED_VERSION = 35
+
+# ListOffsets sentinel timestamps
+TS_LATEST = -1
+TS_EARLIEST = -2
+
+
+# -- CRC32C (Castagnoli) ----------------------------------------------------
+# slice-by-8 tables: ~one order of magnitude over the byte-at-a-time loop in
+# CPython, which matters because the checksum runs on every produced and
+# consumed batch. A native crc32c module is preferred when importable.
+
+_CRC32C_POLY = 0x82F63B78
+
+
+def _make_crc32c_tables():
+    t0 = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for k in range(1, 8):
+        prev = tables[k - 1]
+        tables.append([t0[prev[n] & 0xFF] ^ (prev[n] >> 8) for n in range(256)])
+    return tables
+
+
+_T = _make_crc32c_tables()
+
+
+def _crc32c_py(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    t0, t1, t2, t3, t4, t5, t6, t7 = _T
+    n = len(data)
+    i = 0
+    end8 = n - (n % 8)
+    while i < end8:
+        crc ^= (
+            data[i]
+            | (data[i + 1] << 8)
+            | (data[i + 2] << 16)
+            | (data[i + 3] << 24)
+        )
+        crc = (
+            t7[crc & 0xFF]
+            ^ t6[(crc >> 8) & 0xFF]
+            ^ t5[(crc >> 16) & 0xFF]
+            ^ t4[(crc >> 24) & 0xFF]
+            ^ t3[data[i + 4]]
+            ^ t2[data[i + 5]]
+            ^ t1[data[i + 6]]
+            ^ t0[data[i + 7]]
+        )
+        i += 8
+    while i < n:
+        crc = t0[(crc ^ data[i]) & 0xFF] ^ (crc >> 8)
+        i += 1
+    return crc ^ 0xFFFFFFFF
+
+
+try:  # pragma: no cover - native module not in the baked image
+    from crc32c import crc32c as _crc32c_native  # type: ignore
+
+    def crc32c(data: bytes) -> int:
+        return _crc32c_native(data)
+
+except ImportError:
+    crc32c = _crc32c_py
+
+
+# -- primitive writers ------------------------------------------------------
+
+
+class Writer:
+    __slots__ = ("_parts",)
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(b)
+        return self
+
+    def int8(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">b", v))
+
+    def int16(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">h", v))
+
+    def int32(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">i", v))
+
+    def int64(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">q", v))
+
+    def uint32(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">I", v))
+
+    def boolean(self, v: bool) -> "Writer":
+        return self.int8(1 if v else 0)
+
+    def string(self, s: str | None) -> "Writer":
+        if s is None:
+            return self.int16(-1)
+        b = s.encode("utf-8")
+        return self.int16(len(b)).raw(b)
+
+    def bytes_(self, b: bytes | None) -> "Writer":
+        if b is None:
+            return self.int32(-1)
+        return self.int32(len(b)).raw(b)
+
+    def array(self, items, write_item) -> "Writer":
+        if items is None:
+            return self.int32(-1)
+        self.int32(len(items))
+        for it in items:
+            write_item(self, it)
+        return self
+
+    def varint(self, v: int) -> "Writer":
+        # zigzag int32/64
+        z = (v << 1) ^ (v >> 63)
+        while True:
+            b = z & 0x7F
+            z >>= 7
+            if z:
+                self._parts.append(bytes((b | 0x80,)))
+            else:
+                self._parts.append(bytes((b,)))
+                return self
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes, pos: int = 0):
+        self.data = data
+        self.pos = pos
+
+    def _take(self, n: int) -> bytes:
+        b = self.data[self.pos : self.pos + n]
+        if len(b) != n:
+            raise EOFError(f"need {n} bytes at {self.pos}, have {len(b)}")
+        self.pos += n
+        return b
+
+    def int8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def int16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def int32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def int64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def uint32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def boolean(self) -> bool:
+        return self.int8() != 0
+
+    def string(self) -> str | None:
+        n = self.int16()
+        if n < 0:
+            return None
+        return self._take(n).decode("utf-8")
+
+    def bytes_(self) -> bytes | None:
+        n = self.int32()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def array(self, read_item) -> list | None:
+        n = self.int32()
+        if n < 0:
+            return None
+        return [read_item(self) for _ in range(n)]
+
+    def varint(self) -> int:
+        shift = 0
+        z = 0
+        while True:
+            b = self._take(1)[0]
+            z |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (z >> 1) ^ -(z & 1)
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# -- request/response framing ----------------------------------------------
+
+
+def encode_request(
+    api_key: int,
+    api_version: int,
+    correlation_id: int,
+    client_id: str | None,
+    body: bytes,
+) -> bytes:
+    w = Writer()
+    w.int16(api_key).int16(api_version).int32(correlation_id).string(client_id)
+    payload = w.build() + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+def encode_response(correlation_id: int, body: bytes) -> bytes:
+    payload = struct.pack(">i", correlation_id) + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+def read_frame(sock) -> bytes | None:
+    """Read one length-prefixed frame from a socket; None on clean EOF."""
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            if hdr:
+                raise EOFError("partial frame header")
+            return None
+        hdr += chunk
+    (size,) = struct.unpack(">i", hdr)
+    buf = bytearray()
+    while len(buf) < size:
+        chunk = sock.recv(min(65536, size - len(buf)))
+        if not chunk:
+            raise EOFError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+# -- RecordBatch v2 ---------------------------------------------------------
+# layout (KIP-98): baseOffset int64 | batchLength int32 |
+# partitionLeaderEpoch int32 | magic int8 (=2) | crc uint32 (CRC32C of
+# everything after this field) | attributes int16 | lastOffsetDelta int32 |
+# baseTimestamp int64 | maxTimestamp int64 | producerId int64 |
+# producerEpoch int16 | baseSequence int32 | numRecords int32 | records
+
+
+def encode_record_batch(
+    records: list[tuple[bytes | None, bytes | None]],
+    base_offset: int = 0,
+    base_timestamp: int = 0,
+) -> bytes:
+    """records: list of (key, value); headers always empty (the harness
+    uses value-only messages, unified_producer.py:174)."""
+    body = Writer()
+    for i, (key, value) in enumerate(records):
+        rec = Writer()
+        rec.int8(0)  # attributes
+        rec.varint(0)  # timestampDelta
+        rec.varint(i)  # offsetDelta
+        if key is None:
+            rec.varint(-1)
+        else:
+            rec.varint(len(key)).raw(key)
+        if value is None:
+            rec.varint(-1)
+        else:
+            rec.varint(len(value)).raw(value)
+        rec.varint(0)  # headers count
+        rb = rec.build()
+        body.varint(len(rb)).raw(rb)
+    records_bytes = body.build()
+
+    after_crc = (
+        Writer()
+        .int16(0)  # attributes: no compression, create-time timestamps
+        .int32(len(records) - 1)  # lastOffsetDelta
+        .int64(base_timestamp)
+        .int64(base_timestamp)
+        .int64(-1)  # producerId
+        .int16(-1)  # producerEpoch
+        .int32(-1)  # baseSequence
+        .int32(len(records))
+        .raw(records_bytes)
+        .build()
+    )
+    crc = crc32c(after_crc)
+    tail = Writer().int32(-1).int8(2).uint32(crc).raw(after_crc).build()
+    # batchLength counts partitionLeaderEpoch(4)+magic(1)+crc(4)+after_crc
+    return Writer().int64(base_offset).int32(len(tail)).raw(tail).build()
+
+
+def decode_record_batches(
+    data: bytes, verify_crc: bool = True
+) -> list[tuple[int, bytes | None, bytes | None]]:
+    """Decode a concatenation of RecordBatch v2 blobs into
+    ``[(absolute_offset, key, value), ...]``. Tolerates a trailing partial
+    batch (brokers may truncate at fetch max_bytes)."""
+    out: list[tuple[int, bytes | None, bytes | None]] = []
+    r = Reader(data)
+    while r.remaining() >= 12:
+        base_offset = r.int64()
+        batch_len = r.int32()
+        if r.remaining() < batch_len:
+            break  # truncated tail
+        batch = Reader(r.data, r.pos)
+        r.pos += batch_len
+        batch.int32()  # partitionLeaderEpoch
+        magic = batch.int8()
+        if magic != 2:
+            raise ValueError(f"unsupported record magic {magic}")
+        crc = batch.uint32()
+        after = batch.data[batch.pos : batch.pos + batch_len - 9]
+        if verify_crc and crc32c(after) != crc:
+            raise ValueError("record batch CRC32C mismatch")
+        batch.int16()  # attributes
+        batch.int32()  # lastOffsetDelta
+        batch.int64()  # baseTimestamp
+        batch.int64()  # maxTimestamp
+        batch.int64()  # producerId
+        batch.int16()  # producerEpoch
+        batch.int32()  # baseSequence
+        n = batch.int32()
+        for _ in range(n):
+            rec_len = batch.varint()
+            rec = Reader(batch.data, batch.pos)
+            batch.pos += rec_len
+            rec.int8()  # attributes
+            rec.varint()  # timestampDelta
+            offset_delta = rec.varint()
+            klen = rec.varint()
+            key = rec._take(klen) if klen >= 0 else None
+            vlen = rec.varint()
+            value = rec._take(vlen) if vlen >= 0 else None
+            out.append((base_offset + offset_delta, key, value))
+    return out
