@@ -60,10 +60,10 @@ REFERENCE_TUPLES_PER_SEC = 1400.0  # 4-D/1M anchor, see module docstring
 # --------------------------------------------------------------------------
 
 
-def run_window(cfg, ids, x, required):
+def run_window(cfg, ids, x, required, tracer=None):
     from skyline_tpu.stream import SkylineEngine
 
-    eng = SkylineEngine(cfg)
+    eng = SkylineEngine(cfg, tracer=tracer)
     n = x.shape[0]
     t0 = time.perf_counter()
     chunk = 65536
@@ -127,6 +127,21 @@ def child_main(backend: str) -> None:
     x = anti_correlated(rng, n, d, 0, 10000)
     warm_dt, warm_res = run_window(cfg, ids, x, required)
 
+    # profile window: same workload with a device-syncing Tracer so the
+    # bench JSON carries the per-phase anatomy of a window (syncs distort
+    # pipelining, so this window is NOT included in the measured latencies)
+    from skyline_tpu.metrics.tracing import Tracer
+
+    tracer = Tracer(sync_device=True)
+    prof_dt, _ = run_window(
+        cfg, ids, anti_correlated(rng, n, d, 0, 10000), required, tracer=tracer
+    )
+    phases = {
+        name: round(v["total_ms"], 1)
+        for name, v in tracer.report().items()
+    }
+    phases["profile_window_total"] = round(prof_dt * 1000.0, 1)
+
     lats = []
     sky_sizes = []
     for _ in range(windows):
@@ -157,6 +172,7 @@ def child_main(backend: str) -> None:
                 "windows_measured": windows,
                 "skyline_size_p50": int(np.median(sky_sizes)),
                 "warmup_window_s": round(warm_dt, 2),
+                "phase_breakdown_ms": phases,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }
         )

@@ -20,7 +20,17 @@ from contextlib import contextmanager
 
 
 class Tracer:
-    def __init__(self):
+    """Named phase timers.
+
+    ``sync_device=True`` (default) makes ``device_phase`` block on its
+    arrays, attributing async device work to the phase that launched it —
+    the honest-profiling mode. ``sync_device=False`` records dispatch wall
+    only, leaving the device pipeline undisturbed (device time then lands
+    in whichever later phase forces the sync, e.g. the snapshot transfer).
+    """
+
+    def __init__(self, sync_device: bool = True):
+        self.sync_device = sync_device
         self._total_ns: dict[str, int] = defaultdict(int)
         self._count: dict[str, int] = defaultdict(int)
         self._stack: list[str] = []
@@ -51,7 +61,7 @@ class Tracer:
             yield
         finally:
             self._stack.pop()
-            if arrays_to_sync:
+            if arrays_to_sync and self.sync_device:
                 jax.block_until_ready(arrays_to_sync)
             self._total_ns[name] += time.perf_counter_ns() - t0
             self._count[name] += 1
@@ -73,3 +83,30 @@ class Tracer:
     def reset(self) -> None:
         self._total_ns.clear()
         self._count.clear()
+
+
+class _NullTracer:
+    """Zero-overhead stand-in so hot paths can call ``tracer.phase(...)``
+    unconditionally; ``SkylineEngine``/``PartitionSet`` default to this."""
+
+    sync_device = False
+
+    @contextmanager
+    def phase(self, name: str):
+        yield
+
+    @contextmanager
+    def device_phase(self, name: str, *arrays_to_sync):
+        yield
+
+    def add_ns(self, name: str, ns: int) -> None:
+        pass
+
+    def report(self) -> dict:
+        return {}
+
+    def reset(self) -> None:
+        pass
+
+
+NULL_TRACER = _NullTracer()

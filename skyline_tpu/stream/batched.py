@@ -32,6 +32,7 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
+from skyline_tpu.metrics.tracing import NULL_TRACER
 from skyline_tpu.ops.dispatch import on_tpu
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
@@ -62,6 +63,7 @@ class PartitionSet:
         buffer_size: int = DEFAULT_BUFFER_SIZE,
         mesh=None,
         initial_capacity: int = 0,
+        tracer=None,
     ):
         """``initial_capacity``: pre-size the per-partition skyline buffers
         (rounded up to the power-of-two bucket). Capacity normally grows on
@@ -72,6 +74,7 @@ class PartitionSet:
         self.dims = dims
         self.buffer_size = buffer_size
         self.initial_capacity = initial_capacity
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -156,18 +159,19 @@ class PartitionSet:
         if total == 0:
             return
         t0 = time.perf_counter_ns()
-        rows = [
-            (
-                self._pending[p][0]
-                if len(self._pending[p]) == 1
-                else np.concatenate(self._pending[p], axis=0)
-            )
-            if self._pending[p]
-            else np.empty((0, self.dims), dtype=np.float32)
-            for p in range(self.num_partitions)
-        ]
-        self._pending = [[] for _ in range(self.num_partitions)]
-        self._pending_rows[:] = 0
+        with self.tracer.phase("flush/assemble"):
+            rows = [
+                (
+                    self._pending[p][0]
+                    if len(self._pending[p]) == 1
+                    else np.concatenate(self._pending[p], axis=0)
+                )
+                if self._pending[p]
+                else np.empty((0, self.dims), dtype=np.float32)
+                for p in range(self.num_partitions)
+            ]
+            self._pending = [[] for _ in range(self.num_partitions)]
+            self._pending_rows[:] = 0
 
         max_rows = max(r.shape[0] for r in rows)
         # one common power-of-two batch bucket B; partitions with more than B
@@ -175,18 +179,19 @@ class PartitionSet:
         B = _next_pow2(min(max_rows, max(self.buffer_size, _MIN_CAP)))
         n_rounds = -(-max_rows // B)
         for rnd in range(n_rounds):
-            batch = np.full(
-                (self.num_partitions, B, self.dims), np.inf, dtype=np.float32
-            )
-            bvalid = np.zeros((self.num_partitions, B), dtype=bool)
-            widths = np.zeros(self.num_partitions, dtype=np.int64)
-            for p, r in enumerate(rows):
-                part_rows = r[rnd * B : (rnd + 1) * B]
-                w = part_rows.shape[0]
-                if w:
-                    batch[p, :w] = part_rows
-                    bvalid[p, :w] = True
-                    widths[p] = w
+            with self.tracer.phase("flush/assemble"):
+                batch = np.full(
+                    (self.num_partitions, B, self.dims), np.inf, dtype=np.float32
+                )
+                bvalid = np.zeros((self.num_partitions, B), dtype=bool)
+                widths = np.zeros(self.num_partitions, dtype=np.int64)
+                for p, r in enumerate(rows):
+                    part_rows = r[rnd * B : (rnd + 1) * B]
+                    w = part_rows.shape[0]
+                    if w:
+                        batch[p, :w] = part_rows
+                        bvalid[p, :w] = True
+                        widths[p] = w
             out_cap = max(self._cap, _next_pow2(int((self._count_ub + widths).max())))
             if out_cap > self._cap:
                 # about to grow: tighten the bounds with ONE real count sync
@@ -196,32 +201,34 @@ class PartitionSet:
                 out_cap = max(
                     self._cap, _next_pow2(int((self._count_ub + widths).max()))
                 )
-            if self.mesh is not None:
-                # explicit SPMD: pallas_call has no GSPMD partitioning rule,
-                # so the meshed flush must shard_map over the partition axis
-                # (each device merges only its resident partitions)
-                merge = meshed_merge_step(
-                    self.mesh, self.mesh.axis_names[0], on_tpu(), out_cap
-                )
-                self.sky, self.sky_valid, self._count_dev = merge(
-                    self.sky,
-                    self.sky_valid,
-                    self._put(batch),
-                    self._put(bvalid),
-                )
-            else:
-                merge = (
-                    _merge_step_pallas_batched
-                    if on_tpu()
-                    else _merge_step_batched
-                )
-                self.sky, self.sky_valid, self._count_dev = merge(
-                    self.sky,
-                    self.sky_valid,
-                    self._put(batch),
-                    self._put(bvalid),
-                    out_cap,
-                )
+            with self.tracer.phase("flush/device_put"):
+                batch_dev = self._put(batch)
+                bvalid_dev = self._put(bvalid)
+            with self.tracer.phase("flush/merge_kernel"):
+                if self.mesh is not None:
+                    # explicit SPMD: pallas_call has no GSPMD partitioning
+                    # rule, so the meshed flush must shard_map over the
+                    # partition axis (each device merges only its resident
+                    # partitions)
+                    merge = meshed_merge_step(
+                        self.mesh, self.mesh.axis_names[0], on_tpu(), out_cap
+                    )
+                    self.sky, self.sky_valid, self._count_dev = merge(
+                        self.sky, self.sky_valid, batch_dev, bvalid_dev
+                    )
+                else:
+                    merge = (
+                        _merge_step_pallas_batched
+                        if on_tpu()
+                        else _merge_step_batched
+                    )
+                    self.sky, self.sky_valid, self._count_dev = merge(
+                        self.sky, self.sky_valid, batch_dev, bvalid_dev, out_cap
+                    )
+                if self.tracer.sync_device:
+                    # profiling mode: attribute the async kernel here instead
+                    # of at whichever later phase forces the sync
+                    self._count_dev.block_until_ready()
             self._cap = out_cap
             self._count_ub = np.minimum(out_cap, self._count_ub + widths)
         self._counts_cache = None
@@ -234,13 +241,15 @@ class PartitionSet:
         """Exact survivor counts (P,) — one device sync (cached until the
         next flush)."""
         if self._counts_cache is None:
-            self._counts_cache = np.asarray(self._count_dev, dtype=np.int64)
+            with self.tracer.phase("query/count_sync"):
+                self._counts_cache = np.asarray(self._count_dev, dtype=np.int64)
             self._count_ub = self._counts_cache.copy()
         return self._counts_cache
 
     def _host_sky(self) -> np.ndarray:
         if self._host_cache is None:
-            self._host_cache = np.asarray(self.sky)
+            with self.tracer.phase("query/snapshot_transfer"):
+                self._host_cache = np.asarray(self.sky)
         return self._host_cache
 
     def snapshot(self, p: int) -> np.ndarray:

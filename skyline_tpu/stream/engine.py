@@ -91,7 +91,7 @@ class SkylineEngine:
     ``poll_results`` (each result is a dict with the reference's JSON fields).
     """
 
-    def __init__(self, config: EngineConfig, mesh=None):
+    def __init__(self, config: EngineConfig, mesh=None, tracer=None):
         """``mesh``: optional ``jax.sharding.Mesh`` — logical partitions are
         then sharded across its devices (local flushes run SPMD, one launch
         for the whole set) and the global merge runs as the sharded
@@ -99,9 +99,17 @@ class SkylineEngine:
         (default) runs everything on one chip. The mesh is a runtime
         placement choice, not part of the query semantics, so it lives
         outside ``EngineConfig`` (results are device-count invariant —
-        tests/test_mesh.py pins this)."""
+        tests/test_mesh.py pins this).
+
+        ``tracer``: optional ``metrics.tracing.Tracer`` — wires the
+        per-phase breakdown (route / flush kernels / snapshot transfer /
+        global merge) the reference surfaces as a product feature
+        (SURVEY.md §5); ``None`` costs nothing."""
+        from skyline_tpu.metrics.tracing import NULL_TRACER
+
         self.config = config
         self.mesh = mesh
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         # stacked device state: all partitions' skylines merge in ONE launch
         # per flush (see stream/batched.py); `partitions` are per-partition
         # facades over it
@@ -111,6 +119,7 @@ class SkylineEngine:
             config.buffer_size,
             mesh=mesh,
             initial_capacity=config.initial_capacity,
+            tracer=self.tracer,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
@@ -140,7 +149,10 @@ class SkylineEngine:
             now_ms = time.time() * 1000.0
         cfg = self.config
         self.records_in += values.shape[0]
-        pids = partition_ids_np(values, cfg.algo, cfg.num_partitions, cfg.domain_max)
+        with self.tracer.phase("partition_ids"):
+            pids = partition_ids_np(
+                values, cfg.algo, cfg.num_partitions, cfg.domain_max
+            )
         doomed_pids: np.ndarray | None = None
         if cfg.grid_prefilter:
             mid = cfg.domain_max / 2.0
@@ -165,18 +177,23 @@ class SkylineEngine:
                     ids = ids[keep]
                     pids = pids[keep]
         # group rows by partition with one argsort (the keyBy shuffle)
-        order = np.argsort(pids, kind="stable")
-        sorted_pids = pids[order]
-        sorted_vals = values[order]
-        sorted_ids = ids[order]
-        bounds = np.searchsorted(sorted_pids, np.arange(cfg.num_partitions + 1))
-        for p in range(cfg.num_partitions):
-            lo, hi = bounds[p], bounds[p + 1]
-            if lo == hi:
-                continue
-            part = self.partitions[p]
-            part.add_batch(sorted_vals[lo:hi], int(sorted_ids[lo:hi].max()), now_ms)
-            self._recheck_pending(p, now_ms)
+        with self.tracer.phase("route"):
+            order = np.argsort(pids, kind="stable")
+            sorted_pids = pids[order]
+            sorted_vals = values[order]
+            sorted_ids = ids[order]
+            bounds = np.searchsorted(
+                sorted_pids, np.arange(cfg.num_partitions + 1)
+            )
+            for p in range(cfg.num_partitions):
+                lo, hi = bounds[p], bounds[p + 1]
+                if lo == hi:
+                    continue
+                part = self.partitions[p]
+                part.add_batch(
+                    sorted_vals[lo:hi], int(sorted_ids[lo:hi].max()), now_ms
+                )
+                self._recheck_pending(p, now_ms)
         # one batched launch merges every partition's pending rows at once
         self.pset.maybe_flush()
         if doomed_pids is not None:
@@ -217,19 +234,31 @@ class SkylineEngine:
 
     def _answer(self, p: int, q: _QueryState, now_ms: float) -> None:
         """Partition p finalizes its local skyline for query q
-        (processQuery, FlinkSkyline.java:367-403)."""
+        (processQuery, FlinkSkyline.java:367-403).
+
+        Clock discipline: ``snapshot()`` runs ``flush_all`` whose wall time
+        (possibly seconds, incl. first-query jit compile) accrues to
+        ``processing_ms`` → local_ms. The arrival timestamp must advance
+        past that work — the reference stamps arrival when the partial
+        reaches the aggregator, i.e. AFTER processQuery's flush
+        (FlinkSkyline.java:524-539) — or the decomposition goes impossible
+        (local > total, ingestion clamped). So the snapshot's own wall is
+        added to the caller's clock before recording the arrival."""
         part = self.partitions[p]
+        t0 = time.perf_counter_ns()
         local = part.snapshot()
+        arrival_ms = now_ms + (time.perf_counter_ns() - t0) / 1e6
         start = part.start_time_ms if part.start_time_ms is not None else now_ms
         q.partials[p] = local
         q.local_sizes[p] = local.shape[0]
         q.start_times[p] = start
         q.cpu_ms[p] = part.processing_ms
-        # one clock throughout: the caller-injected now_ms (replay/simulation
-        # friendly) or wall time when the caller left it defaulted
-        q.last_arrival_ms = max(q.last_arrival_ms, now_ms)
+        q.last_arrival_ms = max(q.last_arrival_ms, arrival_ms)
         if len(q.partials) >= self.config.num_partitions:
-            self._finalize(q, now_ms)
+            # successive same-trigger answers share the entry clock, so this
+            # partition's arrival may lag an earlier (flush-absorbing) one —
+            # finalize on the latest arrival so global/total stay >= 0
+            self._finalize(q, max(arrival_ms, q.last_arrival_ms))
 
     def _finalize(
         self, q: _QueryState, now_ms: float, partial_missing: list[int] | None = None
@@ -241,24 +270,28 @@ class SkylineEngine:
         is added on top so global_processing_time_ms stays real even under an
         injected clock."""
         merge_t0 = time.perf_counter_ns()
-        pids_order = sorted(q.partials)
-        stacked = [q.partials[p] for p in pids_order]
-        origins = np.concatenate(
-            [np.full(q.partials[p].shape[0], p, dtype=np.int32) for p in pids_order]
-        )
-        union = (
-            np.concatenate(stacked, axis=0)
-            if origins.size
-            else np.empty((0, self.config.dims), dtype=np.float32)
-        )
+        with self.tracer.phase("global_merge"):
+            pids_order = sorted(q.partials)
+            stacked = [q.partials[p] for p in pids_order]
+            origins = np.concatenate(
+                [
+                    np.full(q.partials[p].shape[0], p, dtype=np.int32)
+                    for p in pids_order
+                ]
+            )
+            union = (
+                np.concatenate(stacked, axis=0)
+                if origins.size
+                else np.empty((0, self.config.dims), dtype=np.float32)
+            )
 
-        if self.mesh is not None:
-            from skyline_tpu.parallel.mesh import skyline_keep_np_sharded
+            if self.mesh is not None:
+                from skyline_tpu.parallel.mesh import skyline_keep_np_sharded
 
-            keep = skyline_keep_np_sharded(self.mesh, union)
-        else:
-            keep = skyline_keep_np(union)
-        global_sky = union[keep]
+                keep = skyline_keep_np_sharded(self.mesh, union)
+            else:
+                keep = skyline_keep_np(union)
+            global_sky = union[keep]
         survivors_per_pid = np.bincount(
             origins[keep], minlength=self.config.num_partitions
         )

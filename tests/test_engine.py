@@ -111,6 +111,28 @@ def test_metrics_fields_present_and_sane(rng):
     assert 0.0 <= r["optimality"] <= 1.0
 
 
+def test_timing_decomposition_invariant(rng):
+    # Regression (round-2 deploy artifact: LocalTime 3713 > TotalTime 2660):
+    # trigger-time snapshot flush wall (incl. first-query jit compile) must
+    # advance the arrival clock, so total >= local always holds
+    # (FlinkSkyline.java:579-588 semantics: total is job-start -> emit).
+    # Injected constant clock + a buffer larger than the feed forces ALL
+    # flush work into the snapshot path — the exact previously-broken case.
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, algo="mr-angle", dims=5, buffer_size=100000)
+    )
+    x = rng.uniform(0, 1000, size=(20000, 5)).astype(np.float32)
+    ids = np.arange(x.shape[0], dtype=np.int64)
+    eng.process_records(ids, x, now_ms=1000.0)
+    eng.process_trigger("0,0", now_ms=1000.0)
+    (r,) = eng.poll_results()
+    assert r["local_processing_time_ms"] > 0  # the flush really ran here
+    assert r["total_processing_time_ms"] >= r["local_processing_time_ms"]
+    assert r["total_processing_time_ms"] >= r["global_processing_time_ms"]
+    assert r["ingestion_time_ms"] >= 0
+    assert r["query_latency_ms"] >= r["total_processing_time_ms"] - 1
+
+
 def test_multiple_sequential_queries_reset_state(rng):
     # per-query state must reset (FlinkSkyline.java:652-657): a second query
     # over more data completes and reflects the larger prefix
