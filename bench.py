@@ -113,6 +113,11 @@ def child_main(backend: str) -> None:
         # 8-D anti-correlated window (~57k/partition -> 64k bucket): skips
         # the per-window capacity-growth syncs/recompiles
         initial_capacity=int(os.environ.get("BENCH_INITIAL_CAP", 65536)),
+        # lazy = sum-sorted append-only SFS at query time: a fraction of the
+        # incremental policy's dominance work for the tumbling
+        # window-then-query pattern (see stream/batched.py). Set
+        # BENCH_FLUSH_POLICY=incremental to measure the streaming cadence.
+        flush_policy=os.environ.get("BENCH_FLUSH_POLICY", "lazy"),
     )
     rng = np.random.default_rng(0)
     ids = np.arange(n, dtype=np.int64)

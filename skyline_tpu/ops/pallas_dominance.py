@@ -28,38 +28,54 @@ from jax.experimental.pallas import tpu as pltpu
 
 from skyline_tpu.ops.dominance import PAD_VALUE
 
-# (rows=dominators, cols=victims) per VMEM tile. 512x1024 masks are 0.5 MB
-# each as int8-ish vregs; d<=16 keeps the unrolled compare cascade small.
+# (rows=dominators, cols=victims) per VMEM tile. Defaults picked by the
+# committed tile sweep (artifacts/kernels_tpu.json: 85 Gpairs/s at 512x2048
+# with the min/max cascade, vs 54 at the old 512x1024 bool-chain kernel).
+# d<=16 keeps the unrolled cascade small.
 ROW_TILE = 512
-COL_TILE = 1024
+COL_TILE = 2048
 
 
-def _kernel_tri(d: int, x_ref, v_ref, y_ref, out_ref):
+def _dom_tile(d: int, x_ref, y_ref, v_ref):
+    """(R, C) dominance tile via the min/max reformulation:
+    ``x dominates y  <=>  max_k(x_k - y_k) <= 0  AND  min_k(x_k - y_k) < 0``
+    — 3 f32 VPU ops per dimension (sub, max, min) instead of the naive
+    4-op compare/bool chain, and the bool work collapses to one pair of
+    compares per tile. Measured ~1.6x the bool-chain kernel
+    (artifacts/kernels_tpu.json)."""
+    diff = x_ref[0, :][:, None] - y_ref[0, :][None, :]
+    mx = diff
+    mn = diff
+    for k in range(1, d):  # static unroll over dimensions
+        dk = x_ref[k, :][:, None] - y_ref[k, :][None, :]
+        mx = jnp.maximum(mx, dk)
+        mn = jnp.minimum(mn, dk)
+    vmask = v_ref[0, :][:, None] > 0.5  # (R, 1) from a 32-bit load
+    return (mx <= 0.0) & (mn < 0.0) & vmask
+
+
+def _kernel_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     """Triangular variant: inputs are pre-sorted by coordinate sum ascending,
     so a row (dominator) tile strictly after the column (victim) tile in sort
     order can never dominate — the whole tile is skipped. Halves the work of
-    the self-skyline case."""
+    the self-skyline case.
+
+    Padding note: +inf pad rows produce diff = inf - y = inf -> mx = inf,
+    never <= 0, so padding stays dominance-neutral; inf - inf = nan
+    compares false on both branches, so pad-vs-pad pairs are inert too."""
     j, i = pl.program_id(0), pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    @pl.when(i * ROW_TILE <= j * COL_TILE + (COL_TILE - 1))
+    @pl.when(i * rt <= j * ct + (ct - 1))
     def _compute():
-        le = jnp.ones((ROW_TILE, COL_TILE), dtype=jnp.bool_)
-        lt = jnp.zeros((ROW_TILE, COL_TILE), dtype=jnp.bool_)
-        for k in range(d):
-            xk = x_ref[k, :][:, None]
-            yk = y_ref[k, :][None, :]
-            le = le & (xk <= yk)
-            lt = lt | (xk < yk)
-        vmask = v_ref[0, :][:, None] > 0.5
-        dom = le & lt & vmask
+        dom = _dom_tile(d, x_ref, y_ref, v_ref)
         out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
-def _kernel(d: int, x_ref, v_ref, y_ref, out_ref):
+def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     # x_ref: (d, R) dominator coords; v_ref: (1, R) dominator validity as
     # float32 (Mosaic can't reshape 1-bit vectors across the minor dim);
     # y_ref: (d, C) victim coords; out_ref: (1, C) accumulated dominated flags
@@ -69,84 +85,97 @@ def _kernel(d: int, x_ref, v_ref, y_ref, out_ref):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    le = jnp.ones((ROW_TILE, COL_TILE), dtype=jnp.bool_)
-    lt = jnp.zeros((ROW_TILE, COL_TILE), dtype=jnp.bool_)
-    for k in range(d):  # static unroll over dimensions
-        xk = x_ref[k, :][:, None]  # (R, 1)
-        yk = y_ref[k, :][None, :]  # (1, C)
-        le = le & (xk <= yk)
-        lt = lt | (xk < yk)
-    vmask = v_ref[0, :][:, None] > 0.5  # (R, 1) from a 32-bit load
-    dom = le & lt & vmask
+    dom = _dom_tile(d, x_ref, y_ref, v_ref)
     out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
-@functools.partial(jax.jit, static_argnames=("triangular", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("triangular", "interpret", "row_tile", "col_tile")
+)
 def dominated_by_any_pallas(
     xt: jax.Array,
     valid: jax.Array,
     triangular: bool = False,
     interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
 ) -> jax.Array:
     """dominated[j] = any valid i dominates j, over one transposed set.
 
     xt: (d, N) float32 with PAD_VALUE columns for padding; valid: (N,) bool.
-    N must be a multiple of lcm(ROW_TILE, COL_TILE) — use ``skyline_mask_pallas``
+    N must be a multiple of lcm(row_tile, col_tile) — use ``skyline_mask_pallas``
     which handles padding. Self-pairs are safe (a point never dominates
     itself) and padding columns never dominate (+inf is never <=).
     ``triangular=True`` requires rows sorted by coordinate sum ascending.
     """
     d, n = xt.shape
-    grid = (n // COL_TILE, n // ROW_TILE)
+    # clamp tiles to the problem size (callers pad to >=1024-row buckets);
+    # without this a 1024-cap buffer meets a 2048 default tile -> empty grid
+    rt, ct = min(row_tile, n), min(col_tile, n)
+    grid = (n // ct, n // rt)
     v2 = valid[None, :].astype(jnp.float32)  # (1, N), 32-bit for Mosaic
     kern = _kernel_tri if triangular else _kernel
     out = pl.pallas_call(
-        functools.partial(kern, d),
+        functools.partial(kern, d, rt, ct),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((d, ROW_TILE), lambda j, i: (0, i)),  # dominators
-            pl.BlockSpec((1, ROW_TILE), lambda j, i: (0, i)),  # their validity
-            pl.BlockSpec((d, COL_TILE), lambda j, i: (0, j)),  # victims
+            pl.BlockSpec((d, rt), lambda j, i: (0, i)),  # dominators
+            pl.BlockSpec((1, rt), lambda j, i: (0, i)),  # their validity
+            pl.BlockSpec((d, ct), lambda j, i: (0, j)),  # victims
         ],
-        out_specs=pl.BlockSpec((1, COL_TILE), lambda j, i: (0, j)),
+        out_specs=pl.BlockSpec((1, ct), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.bool_),
         interpret=interpret,
     )(xt, v2, xt)
     return out[0]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+)
 def dominated_by_pallas(
-    xt: jax.Array, x_valid: jax.Array, yt: jax.Array, interpret: bool = False
+    xt: jax.Array,
+    x_valid: jax.Array,
+    yt: jax.Array,
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
 ) -> jax.Array:
     """Rectangular variant: dominated[j] = any valid x_i dominates y_j.
 
-    xt: (d, Nx) dominators (Nx % ROW_TILE == 0); yt: (d, Ny) victims
-    (Ny % COL_TILE == 0). The streaming flush's batch-vs-skyline prune maps
+    xt: (d, Nx) dominators (Nx % row_tile == 0); yt: (d, Ny) victims
+    (Ny % col_tile == 0). The streaming flush's batch-vs-skyline prune maps
     here directly.
     """
     d, nx = xt.shape
     _, ny = yt.shape
-    grid = (ny // COL_TILE, nx // ROW_TILE)
+    rt, ct = min(row_tile, nx), min(col_tile, ny)
+    grid = (ny // ct, nx // rt)
     v2 = x_valid[None, :].astype(jnp.float32)
     out = pl.pallas_call(
-        functools.partial(_kernel, d),
+        functools.partial(_kernel, d, rt, ct),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((d, ROW_TILE), lambda j, i: (0, i)),
-            pl.BlockSpec((1, ROW_TILE), lambda j, i: (0, i)),
-            pl.BlockSpec((d, COL_TILE), lambda j, i: (0, j)),
+            pl.BlockSpec((d, rt), lambda j, i: (0, i)),
+            pl.BlockSpec((1, rt), lambda j, i: (0, i)),
+            pl.BlockSpec((d, ct), lambda j, i: (0, j)),
         ],
-        out_specs=pl.BlockSpec((1, COL_TILE), lambda j, i: (0, j)),
+        out_specs=pl.BlockSpec((1, ct), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((1, ny), jnp.bool_),
         interpret=interpret,
     )(xt, v2, yt)
     return out[0]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+)
 def skyline_mask_pallas(
-    x: jax.Array, valid: jax.Array | None = None, interpret: bool = False
+    x: jax.Array,
+    valid: jax.Array | None = None,
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
 ) -> jax.Array:
     """Survivor mask over (N, d) points via the Pallas dominance kernel.
 
@@ -157,7 +186,7 @@ def skyline_mask_pallas(
     n, d = x.shape
     if valid is None:
         valid = jnp.ones((n,), dtype=bool)
-    tile = max(ROW_TILE, COL_TILE)
+    tile = max(row_tile, col_tile)
     padded = -(-n // tile) * tile
     if padded != n:
         pad_x = jnp.full((padded - n, d), PAD_VALUE, dtype=x.dtype)
@@ -171,7 +200,12 @@ def skyline_mask_pallas(
     xs = x[order]
     vs = valid[order]
     dominated = dominated_by_any_pallas(
-        xs.T, vs, triangular=True, interpret=interpret
+        xs.T,
+        vs,
+        triangular=True,
+        interpret=interpret,
+        row_tile=row_tile,
+        col_tile=col_tile,
     )
     keep_sorted = ~dominated & vs
     return keep_sorted[inv][:n]

@@ -40,7 +40,11 @@ from skyline_tpu.stream.window import (
     _merge_step_batched,
     _merge_step_pallas_batched,
     _next_pow2,
+    global_merge_stats_device,
+    global_points_device,
     meshed_merge_step,
+    sfs_cleanup,
+    sfs_round,
 )
 
 
@@ -64,17 +68,39 @@ class PartitionSet:
         mesh=None,
         initial_capacity: int = 0,
         tracer=None,
+        flush_policy: str = "incremental",
     ):
         """``initial_capacity``: pre-size the per-partition skyline buffers
         (rounded up to the power-of-two bucket). Capacity normally grows on
         demand with one count sync per doubling; a workload that knows its
         steady-state skyline size (e.g. repeated same-shape windows) can
-        pre-size to skip every growth step and its sync."""
+        pre-size to skip every growth step and its sync.
+
+        ``flush_policy``:
+
+        - ``"incremental"`` (default): merge pending rows into the running
+          skylines whenever the largest partition's pending buffer reaches
+          ``buffer_size`` — the reference's processBuffer cadence
+          (FlinkSkyline.java:232). Work is spread across ingest; memory for
+          pending rows is bounded by the threshold.
+        - ``"lazy"``: accumulate pending rows (host RAM ~ window size) and
+          compute at query time via sum-sorted append-only SFS rounds — no
+          buffer re-pruning, no full-buffer compaction. For
+          tumbling-window-then-query streams this does a fraction of the
+          incremental policy's dominance work (see stream/window.py SFS
+          notes). Results are identical (the merge law). Requires
+          ``mesh=None`` (the SFS rounds are single-device vmapped kernels).
+        """
         self.num_partitions = num_partitions
         self.dims = dims
         self.buffer_size = buffer_size
         self.initial_capacity = initial_capacity
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        if flush_policy not in ("incremental", "lazy"):
+            raise ValueError(f"unknown flush_policy {flush_policy!r}")
+        if flush_policy == "lazy" and mesh is not None:
+            raise ValueError("flush_policy='lazy' requires mesh=None")
+        self.flush_policy = flush_policy
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -145,33 +171,65 @@ class PartitionSet:
     def maybe_flush(self) -> bool:
         """Flush all partitions once the largest pending buffer reaches
         ``buffer_size`` (the processBuffer threshold, FlinkSkyline.java:232,
-        applied set-wide). Returns True if a flush happened."""
+        applied set-wide). Returns True if a flush happened. Under the lazy
+        policy this never fires — all work happens at query time."""
+        if self.flush_policy == "lazy":
+            return False
         if int(self._pending_rows.max()) >= self.buffer_size:
             self.flush_all()
             return True
         return False
 
+
+    def _drain_pending(self) -> list[np.ndarray]:
+        """Move every partition's pending micro-batches out as one (m, d)
+        array per partition (empty partitions get (0, d)), clearing the
+        pending state. Shared by both flush policies."""
+        rows = [
+            (
+                self._pending[p][0]
+                if len(self._pending[p]) == 1
+                else np.concatenate(self._pending[p], axis=0)
+            )
+            if self._pending[p]
+            else np.empty((0, self.dims), dtype=np.float32)
+            for p in range(self.num_partitions)
+        ]
+        self._pending = [[] for _ in range(self.num_partitions)]
+        self._pending_rows[:] = 0
+        return rows
+
+    def _round_batch(self, rows: list[np.ndarray], rnd: int, B: int):
+        """Assemble round ``rnd``'s (P, B, d) padded batch + validity +
+        per-partition widths from the drained ``rows``."""
+        batch = np.full(
+            (self.num_partitions, B, self.dims), np.inf, dtype=np.float32
+        )
+        bvalid = np.zeros((self.num_partitions, B), dtype=bool)
+        widths = np.zeros(self.num_partitions, dtype=np.int64)
+        for p, r in enumerate(rows):
+            part_rows = r[rnd * B : (rnd + 1) * B]
+            w = part_rows.shape[0]
+            if w:
+                batch[p, :w] = part_rows
+                bvalid[p, :w] = True
+                widths[p] = w
+        return batch, bvalid, widths
+
     def flush_all(self) -> None:
-        """Merge every partition's pending rows into its running skyline in
-        one batched device launch (or a few, if one partition's pending
-        vastly exceeds the common batch bucket)."""
+        """Merge every partition's pending rows into its running skyline:
+        one batched device launch per round (incremental policy), or
+        append-only SFS rounds over the sum-sorted pending windows (lazy
+        policy)."""
         total = int(self._pending_rows.sum())
         if total == 0:
             return
+        if self.flush_policy == "lazy":
+            self._flush_lazy()
+            return
         t0 = time.perf_counter_ns()
         with self.tracer.phase("flush/assemble"):
-            rows = [
-                (
-                    self._pending[p][0]
-                    if len(self._pending[p]) == 1
-                    else np.concatenate(self._pending[p], axis=0)
-                )
-                if self._pending[p]
-                else np.empty((0, self.dims), dtype=np.float32)
-                for p in range(self.num_partitions)
-            ]
-            self._pending = [[] for _ in range(self.num_partitions)]
-            self._pending_rows[:] = 0
+            rows = self._drain_pending()
 
         max_rows = max(r.shape[0] for r in rows)
         # one common power-of-two batch bucket B; partitions with more than B
@@ -180,18 +238,7 @@ class PartitionSet:
         n_rounds = -(-max_rows // B)
         for rnd in range(n_rounds):
             with self.tracer.phase("flush/assemble"):
-                batch = np.full(
-                    (self.num_partitions, B, self.dims), np.inf, dtype=np.float32
-                )
-                bvalid = np.zeros((self.num_partitions, B), dtype=bool)
-                widths = np.zeros(self.num_partitions, dtype=np.int64)
-                for p, r in enumerate(rows):
-                    part_rows = r[rnd * B : (rnd + 1) * B]
-                    w = part_rows.shape[0]
-                    if w:
-                        batch[p, :w] = part_rows
-                        bvalid[p, :w] = True
-                        widths[p] = w
+                batch, bvalid, widths = self._round_batch(rows, rnd, B)
             out_cap = max(self._cap, _next_pow2(int((self._count_ub + widths).max())))
             if out_cap > self._cap:
                 # about to grow: tighten the bounds with ONE real count sync
@@ -227,15 +274,129 @@ class PartitionSet:
                     )
                 if self.tracer.sync_device:
                     # profiling mode: attribute the async kernel here instead
-                    # of at whichever later phase forces the sync
-                    self._count_dev.block_until_ready()
+                    # of at whichever later phase forces the sync. A host
+                    # read, not block_until_ready — the latter can return
+                    # early on the axon remote-TPU platform.
+                    np.asarray(self._count_dev)
             self._cap = out_cap
             self._count_ub = np.minimum(out_cap, self._count_ub + widths)
         self._counts_cache = None
         self._host_cache = None
         self.processing_ns += time.perf_counter_ns() - t0
 
+    def _flush_lazy(self) -> None:
+        """Lazy-policy flush: sum-sort each partition's accumulated window
+        and stream it through append-only SFS rounds (one vmapped launch per
+        round). See stream/window.py's SFS notes for the invariant."""
+        t0 = time.perf_counter_ns()
+        with self.tracer.phase("flush/assemble"):
+            rows = self._drain_pending()
+            for p, r in enumerate(rows):
+                if r.shape[0] > 1:
+                    order = np.argsort(r.sum(axis=1), kind="stable")
+                    rows[p] = r[order]
+        # non-empty initial state needs exact old counts for the final
+        # old-vs-new cleanup pass (one sync; fresh windows skip it)
+        had_old = bool((self._count_ub > 0).any())
+        old_counts = (
+            self.sky_counts().astype(np.int32) if had_old else None
+        )
+        if had_old and not int(old_counts.max()):
+            had_old = False
+
+        max_rows = max(r.shape[0] for r in rows)
+        # bigger blocks than the incremental threshold pay off here: the
+        # cross-prune work is block-count invariant, so fewer rounds just
+        # save dispatches (at B^2/2 self-prune cost per round)
+        B = _next_pow2(min(max_rows, max(self.buffer_size, 8192)))
+        n_rounds = -(-max_rows // B)
+        counts = self._count_dev
+        for rnd in range(n_rounds):
+            with self.tracer.phase("flush/assemble"):
+                batch, bvalid, widths = self._round_batch(rows, rnd, B)
+            # the SFS append writes a full B-row block at offset count, so
+            # capacity must cover count + B for every partition
+            need = int(self._count_ub.max()) + B
+            if need > self._cap:
+                self._count_ub = np.asarray(counts, dtype=np.int64)
+                need = int(self._count_ub.max()) + B
+                if need > self._cap:
+                    new_cap = _next_pow2(need)
+                    pad = jnp.full(
+                        (self.num_partitions, new_cap - self._cap, self.dims),
+                        jnp.inf,
+                        dtype=jnp.float32,
+                    )
+                    self.sky = jnp.concatenate([self.sky, pad], axis=1)
+                    self._cap = new_cap
+            active = min(
+                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+            )
+            with self.tracer.phase("flush/device_put"):
+                batch_dev = jnp.asarray(batch)
+                bvalid_dev = jnp.asarray(bvalid)
+            with self.tracer.phase("flush/merge_kernel"):
+                self.sky, counts = sfs_round(
+                    self.sky, counts, batch_dev, bvalid_dev, active
+                )
+                if self.tracer.sync_device:
+                    np.asarray(counts)
+            self._count_ub = np.minimum(self._cap, self._count_ub + widths)
+        if had_old:
+            old_active = min(
+                self._cap, _next_pow2(max(int(old_counts.max()), 1))
+            )
+            active = min(
+                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+            )
+            with self.tracer.phase("flush/merge_kernel"):
+                self.sky, counts = sfs_cleanup(
+                    self.sky, counts, jnp.asarray(old_counts),
+                    old_active, active,
+                )
+                if self.tracer.sync_device:
+                    np.asarray(counts)
+        self._count_dev = counts
+        # validity is a pure function of counts under append-only state
+        self.sky_valid = jnp.arange(self._cap)[None, :] < counts[:, None]
+        self._counts_cache = None
+        self._host_cache = None
+        self.processing_ns += time.perf_counter_ns() - t0
+
     # -- query ------------------------------------------------------------
+
+    def global_merge_stats(self, emit_points: bool = False):
+        """Device-side global merge over the (flushed) stacked state.
+
+        Returns ``(counts (P,), survivors_per_partition (P,), global_count,
+        points | None)`` with ONE small device->host transfer for the stats
+        (plus one bounded transfer when ``emit_points``) — replacing the
+        full-buffer snapshot pull + host merge + re-upload. Single-device
+        only (the engine falls back to the host path under a mesh).
+        """
+        # the count upper bounds are maintained without syncs, so this
+        # active bucket costs no round trip (pessimistic is safe: rows
+        # between count and active are invalid by the mask)
+        active = min(
+            self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+        )
+        keep, stats = global_merge_stats_device(
+            self.sky, self._count_dev, active
+        )
+        with self.tracer.phase("query/global_stats_sync"):
+            svec = np.asarray(stats, dtype=np.int64)
+        P = self.num_partitions
+        counts, surv, g = svec[:P].copy(), svec[P : 2 * P].copy(), int(svec[2 * P])
+        pts = None
+        if emit_points:
+            out_cap = _next_pow2(max(g, 1))
+            with self.tracer.phase("query/points_transfer"):
+                pts = np.asarray(
+                    global_points_device(self.sky, keep, active, out_cap)
+                )[:g].copy()
+        self._counts_cache = counts.copy()
+        self._count_ub = counts.copy()
+        return counts, surv, g, pts
 
     def sky_counts(self) -> np.ndarray:
         """Exact survivor counts (P,) — one device sync (cached until the

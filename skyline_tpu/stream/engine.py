@@ -61,6 +61,12 @@ class EngineConfig:
     # pre-size per-partition skyline buffers (0 = grow on demand); see
     # PartitionSet.initial_capacity
     initial_capacity: int = 0
+    # "incremental": merge pending rows at the buffer_size cadence (the
+    # reference's processBuffer model); "lazy": accumulate and compute at
+    # query time via append-only SFS rounds — far less total work for
+    # tumbling-window-then-query streams (see stream/batched.py). Identical
+    # results either way; lazy requires mesh=None.
+    flush_policy: str = "incremental"
 
     @property
     def num_partitions(self) -> int:
@@ -120,6 +126,7 @@ class SkylineEngine:
             mesh=mesh,
             initial_capacity=config.initial_capacity,
             tracer=self.tracer,
+            flush_policy=config.flush_policy,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
@@ -207,12 +214,25 @@ class SkylineEngine:
 
     def process_trigger(self, payload: str, now_ms: float | None = None) -> None:
         """Broadcast a query trigger to every partition (the flatMap fan-out,
-        FlinkSkyline.java:145-157)."""
+        FlinkSkyline.java:145-157).
+
+        Fast path: when every partition's barrier passes at dispatch (the
+        dominant case — a trigger after its window is ingested) and the
+        engine is single-device, the local snapshots and the global merge
+        all run on device with only per-partition counts coming back to
+        host; the full local-skyline buffers are never transferred."""
         if now_ms is None:
             now_ms = time.time() * 1000.0
         qid, required = parse_trigger(payload)
         q = _QueryState(qid=qid, payload=payload, required=required, dispatch_ms=now_ms)
         self._inflight[payload] = q
+        all_ready = all(
+            part.max_seen_id >= required or part.max_seen_id == -1
+            for part in self.partitions
+        )
+        if all_ready and self.mesh is None:
+            self._answer_all_device(q, now_ms)
+            return
         for p in range(self.config.num_partitions):
             part = self.partitions[p]
             if part.max_seen_id >= required or part.max_seen_id == -1:
@@ -319,6 +339,33 @@ class SkylineEngine:
                 ratios += survivors_per_pid[p] / size
         optimality = ratios / self.config.num_partitions
 
+        self._emit_result(
+            q,
+            skyline_size=int(global_sky.shape[0]),
+            optimality=float(optimality),
+            ingestion=ingestion,
+            local_ms=local_ms,
+            global_ms=global_ms,
+            total_ms=total_ms,
+            latency_ms=latency_ms,
+            points=global_sky if self.config.emit_skyline_points else None,
+            partial_missing=partial_missing,
+        )
+
+    def _emit_result(
+        self,
+        q: _QueryState,
+        *,
+        skyline_size: int,
+        optimality: float,
+        ingestion: float,
+        local_ms: float,
+        global_ms: float,
+        total_ms: float,
+        latency_ms: float,
+        points=None,
+        partial_missing=None,
+    ) -> None:
         # record_count is echoed from the payload's second field; the
         # reference emits the literal string (FlinkSkyline.java:640-642),
         # which for a count-less payload would produce invalid JSON
@@ -328,8 +375,8 @@ class SkylineEngine:
         result = {
             "query_id": q.qid,
             "record_count": record_count,
-            "skyline_size": int(global_sky.shape[0]),
-            "optimality": float(optimality),
+            "skyline_size": skyline_size,
+            "optimality": optimality,
             "ingestion_time_ms": int(ingestion),
             "local_processing_time_ms": int(local_ms),
             "global_processing_time_ms": int(global_ms),
@@ -339,10 +386,53 @@ class SkylineEngine:
         if partial_missing is not None:
             result["partial"] = True
             result["missing_partitions"] = partial_missing
-        if self.config.emit_skyline_points:
-            result["skyline_points"] = global_sky.tolist()
+        if points is not None:
+            result["skyline_points"] = (
+                points.tolist() if hasattr(points, "tolist") else points
+            )
         self._results.append(result)
         self._inflight.pop(q.payload, None)
+
+    def _answer_all_device(self, q: _QueryState, now_ms: float) -> None:
+        """All barriers passed at dispatch: answer every partition and run
+        the global merge on device. Equivalent to _answer x P followed by
+        _finalize, but local skylines never leave the device — only the
+        packed (counts, survivors, global_count) stats vector (plus the
+        compacted points buffer when requested) transfers.
+
+        Timing decomposition follows the same clock discipline as
+        _answer/_finalize: the flush wall advances the arrival clock (local
+        phase); the merge wall rides on top (global phase)."""
+        t0 = time.perf_counter_ns()
+        self.pset.flush_all()
+        flush_wall_ms = (time.perf_counter_ns() - t0) / 1e6
+        t1 = time.perf_counter_ns()
+        counts, surv, g, pts = self.pset.global_merge_stats(
+            emit_points=self.config.emit_skyline_points
+        )
+        merge_ms = (time.perf_counter_ns() - t1) / 1e6
+
+        starts = [s for s in self.pset.start_time_ms if s is not None]
+        map_finish = now_ms + flush_wall_ms
+        now = map_finish + merge_ms
+        job_start = min(starts) if starts else now
+        local_ms = self.pset.processing_ms
+        map_wall = max(0.0, map_finish - job_start)
+        ratios = 0.0
+        for p in range(self.config.num_partitions):
+            if counts[p] > 0:
+                ratios += surv[p] / counts[p]
+        self._emit_result(
+            q,
+            skyline_size=g,
+            optimality=ratios / self.config.num_partitions,
+            ingestion=max(0.0, map_wall - local_ms),
+            local_ms=local_ms,
+            global_ms=now - map_finish,
+            total_ms=now - job_start,
+            latency_ms=now - q.dispatch_ms,
+            points=pts if self.config.emit_skyline_points else None,
+        )
 
     # -- failure detection -------------------------------------------------
 
