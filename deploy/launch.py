@@ -111,7 +111,24 @@ def main(argv=None) -> int:
     ap.add_argument("--log-dir", default="deploy_logs")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the worker to the CPU backend (no TPU attempt)")
+    ap.add_argument("--query-timeout-ms", type=float, default=0.0,
+                    help="worker failure watchdog: finalize overdue queries "
+                         "as partial results (0 = wait forever)")
+    ap.add_argument("--flush-policy", choices=("incremental", "lazy"),
+                    default="incremental")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard the worker's partition state over this many "
+                         "devices (0 = single device)")
+    ap.add_argument("--stats-port", type=int, default=18081,
+                    help="worker live-stats port (the Flink Web UI :8081 "
+                         "role); 0 disables")
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window size in tuples (0 = unbounded)")
+    ap.add_argument("--slide", type=int, default=0,
+                    help="slide in tuples (with --window)")
     args = ap.parse_args(argv)
+    if (args.window > 0) != (args.slide > 0):
+        ap.error("--window and --slide must be given together")
 
     stack = Stack(args.log_dir)
     worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
@@ -124,14 +141,22 @@ def main(argv=None) -> int:
                  "--host", host, "--port", port or "9092"],
             )
         wait_for_broker(args.bootstrap)
-        stack.start(
-            "worker",
-            ["-m", "skyline_tpu.bridge.worker",
-             "--bootstrap", args.bootstrap, "--algo", args.algo,
-             "--dims", str(args.dims), "--parallelism", str(args.parallelism),
-             "--domain", str(args.domain)],
-            env=worker_env,
-        )
+        worker_args = [
+            "-m", "skyline_tpu.bridge.worker",
+            "--bootstrap", args.bootstrap, "--algo", args.algo,
+            "--dims", str(args.dims), "--parallelism", str(args.parallelism),
+            "--domain", str(args.domain),
+            "--flush-policy", args.flush_policy,
+            "--stats-port", str(args.stats_port),
+        ]
+        if args.query_timeout_ms:
+            worker_args += ["--query-timeout-ms", str(args.query_timeout_ms)]
+        if args.mesh:
+            worker_args += ["--mesh", str(args.mesh)]
+        if args.window:
+            worker_args += ["--window", str(args.window),
+                            "--slide", str(args.slide)]
+        stack.start("worker", worker_args, env=worker_env)
         csv_path = args.out_csv
         if os.path.isfile(csv_path):
             os.remove(csv_path)

@@ -28,13 +28,61 @@ class SkylineWorker:
         input_topic: str = INPUT_TOPIC,
         query_topic: str = QUERY_TOPIC,
         output_topic: str = OUTPUT_TOPIC,
+        mesh=None,
+        stats_port: int | None = None,
+        window_size: int = 0,
+        slide: int = 0,
+        emit_per_slide: bool = False,
     ):
+        """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
+        across its devices (multi-chip streaming). ``stats_port``: serve
+        live /stats + /healthz JSON on this port (0 picks a free one; None
+        disables) — the Flink-Web-UI role for this stack. ``window_size`` +
+        ``slide`` (both > 0) switch the worker to the sliding-window engine
+        (``stream.sliding_engine``), same transport and result planes."""
         self.bus = bus
-        self.engine = SkylineEngine(config)
+        if window_size:
+            from skyline_tpu.stream.sliding_engine import SlidingEngine
+
+            self.engine = SlidingEngine(
+                config,
+                window_size=window_size,
+                slide=slide,
+                mesh=mesh,
+                emit_per_slide=emit_per_slide,
+            )
+        else:
+            self.engine = SkylineEngine(config, mesh=mesh)
         self.output_topic = output_topic
         self._data = bus.consumer(input_topic, from_beginning=True)
         self._queries = bus.consumer(query_topic, from_beginning=False)
         self.results_emitted = 0
+        self.stats_server = None
+        if stats_port is not None:
+            from skyline_tpu.metrics.httpstats import StatsServer
+
+            try:
+                self.stats_server = StatsServer(self.stats, stats_port)
+            except OSError as e:
+                # observability is optional: a port conflict must not take
+                # the worker (and with it the whole deploy stack) down
+                import sys
+
+                print(
+                    f"skyline worker: stats port {stats_port} unavailable "
+                    f"({e}); continuing without /stats",
+                    file=sys.stderr,
+                )
+
+    def stats(self) -> dict:
+        """Engine counters + worker I/O counters (served by /stats)."""
+        out = self.engine.stats()
+        out["results_emitted"] = self.results_emitted
+        return out
+
+    def close(self) -> None:
+        if self.stats_server is not None:
+            self.stats_server.close()
 
     def step(self, max_records: int = 65536) -> int:
         """One poll cycle: drain data, drain triggers, emit finished results.
@@ -87,13 +135,22 @@ def main(argv=None):
         input_topic=cfg.input_topic,
         query_topic=cfg.query_topic,
         output_topic=cfg.output_topic,
+        mesh=cfg.build_mesh(),
+        stats_port=cfg.stats_port if cfg.stats_port > 0 else None,
+        window_size=cfg.window_size,
+        slide=cfg.slide,
+        emit_per_slide=cfg.emit_per_slide,
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
-        f"dims={cfg.dims} broker={cfg.bootstrap}",
+        f"dims={cfg.dims} broker={cfg.bootstrap} mesh={cfg.mesh or 'off'}"
+        + (f" stats=:{worker.stats_server.port}" if worker.stats_server else ""),
         file=sys.stderr,
     )
-    worker.run_forever()
+    try:
+        worker.run_forever()
+    finally:
+        worker.close()
     return 0
 
 

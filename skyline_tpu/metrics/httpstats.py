@@ -1,0 +1,65 @@
+"""Live observability endpoint — the Flink Web UI role, minimally.
+
+The reference operator watches Flink's Web UI on :8081
+(/root/reference/docker-setup/docker-compose.yml:26) while a job runs. The
+TPU worker's equivalent surface is ``SkylineEngine.stats()`` — this module
+serves it (plus any caller-supplied counters) as JSON over a stdlib
+``http.server`` thread, so ``curl localhost:<port>/stats`` works during a
+``deploy/launch.py`` run.
+
+Endpoints:
+  GET /stats    full stats JSON (engine counters, partitions, worker I/O)
+  GET /healthz  {"ok": true} once serving — readiness probe for supervisors
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class StatsServer:
+    """Background JSON stats server.
+
+    ``callback`` is invoked per /stats request and must return a
+    JSON-serializable dict; exceptions become a 500 with the error message
+    (the server never takes the worker down).
+    """
+
+    def __init__(self, callback, port: int, host: str = "127.0.0.1"):
+        self._callback = callback
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(handler):  # noqa: N805 — http.server API
+                if handler.path == "/healthz":
+                    handler._reply(200, {"ok": True})
+                elif handler.path in ("/", "/stats"):
+                    try:
+                        handler._reply(200, callback())
+                    except Exception as e:  # pragma: no cover - defensive
+                        handler._reply(500, {"error": str(e)})
+                else:
+                    handler._reply(404, {"error": "not found"})
+
+            def _reply(handler, code: int, doc: dict):
+                body = json.dumps(doc).encode()
+                handler.send_response(code)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
+
+            def log_message(handler, *args):  # silence per-request stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]  # resolved when port=0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

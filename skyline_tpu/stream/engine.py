@@ -183,7 +183,11 @@ class SkylineEngine:
                     values = values[keep]
                     ids = ids[keep]
                     pids = pids[keep]
-        # group rows by partition with one argsort (the keyBy shuffle)
+        # group rows by partition with one argsort (the keyBy shuffle).
+        # now_ms advances through the loop: an answer's snapshot flush can
+        # take seconds (first-query compile), and later answers in the SAME
+        # call must see a clock past it or the timing decomposition goes
+        # impossible (local > total) — the round-2 deploy-artifact bug.
         with self.tracer.phase("route"):
             order = np.argsort(pids, kind="stable")
             sorted_pids = pids[order]
@@ -200,7 +204,7 @@ class SkylineEngine:
                 part.add_batch(
                     sorted_vals[lo:hi], int(sorted_ids[lo:hi].max()), now_ms
                 )
-                self._recheck_pending(p, now_ms)
+                now_ms = self._recheck_pending(p, now_ms)
         # one batched launch merges every partition's pending rows at once
         self.pset.maybe_flush()
         if doomed_pids is not None:
@@ -208,7 +212,7 @@ class SkylineEngine:
             # need their pending queries rechecked (after the kept rows of
             # this batch have routed, so answers reflect the full batch)
             for p in doomed_pids:
-                self._recheck_pending(int(p), now_ms)
+                now_ms = self._recheck_pending(int(p), now_ms)
 
     # -- control plane ----------------------------------------------------
 
@@ -236,23 +240,26 @@ class SkylineEngine:
         for p in range(self.config.num_partitions):
             part = self.partitions[p]
             if part.max_seen_id >= required or part.max_seen_id == -1:
-                self._answer(p, q, now_ms)
+                now_ms = self._answer(p, q, now_ms)
             else:
                 self._pending_queries[p].append(q)
 
-    def _recheck_pending(self, p: int, now_ms: float) -> None:
+    def _recheck_pending(self, p: int, now_ms: float) -> float:
+        """Returns the advanced clock (answers add their snapshot wall so
+        the caller's subsequent answers don't time-travel before them)."""
         part = self.partitions[p]
         still = []
         for q in self._pending_queries[p]:
             if part.max_seen_id >= q.required:
-                self._answer(p, q, now_ms)
+                now_ms = self._answer(p, q, now_ms)
             else:
                 still.append(q)
         self._pending_queries[p] = still
+        return now_ms
 
     # -- local answer + global aggregation --------------------------------
 
-    def _answer(self, p: int, q: _QueryState, now_ms: float) -> None:
+    def _answer(self, p: int, q: _QueryState, now_ms: float) -> float:
         """Partition p finalizes its local skyline for query q
         (processQuery, FlinkSkyline.java:367-403).
 
@@ -275,10 +282,8 @@ class SkylineEngine:
         q.cpu_ms[p] = part.processing_ms
         q.last_arrival_ms = max(q.last_arrival_ms, arrival_ms)
         if len(q.partials) >= self.config.num_partitions:
-            # successive same-trigger answers share the entry clock, so this
-            # partition's arrival may lag an earlier (flush-absorbing) one —
-            # finalize on the latest arrival so global/total stay >= 0
             self._finalize(q, max(arrival_ms, q.last_arrival_ms))
+        return arrival_ms
 
     def _finalize(
         self, q: _QueryState, now_ms: float, partial_missing: list[int] | None = None

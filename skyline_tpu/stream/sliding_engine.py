@@ -1,0 +1,444 @@
+"""Sliding-window streaming engine: partitioned continuous skyline.
+
+First-class engine mode for BASELINE config #4 (count-based sliding window,
+high overlap) with the same external surface as ``SkylineEngine``:
+``process_records`` / ``process_trigger`` / ``poll_results`` / ``stats``,
+the same partitioners, id-barrier trigger semantics and result JSON — so the
+worker, collector, and deploy stack drive it unchanged. The reference has no
+eviction at all (its skyline spans the unbounded stream), so this whole mode
+is a capability extension built on the bucket-ring decomposition of
+``stream/sliding.py``.
+
+Semantics. The stream is cut into global slides of ``slide`` tuples (by
+arrival order, exactly — incoming batches are split at slide boundaries
+before routing). A window is the last ``K = window_size / slide`` closed
+buckets. Each partition keeps a device ring of its OWN rows per bucket
+(bucket skylines computed once at close — the merge law makes the union
+exact, SURVEY.md §4); eviction is a ring-slot overwrite. A query trigger
+answers over the current window plus the in-progress slide's rows (bucket-
+granular eviction: between ``window_size`` and ``window_size + slide - 1``
+most recent tuples — the same contract as ``SlidingSkyline.current_skyline``).
+
+TPU shape: rings are stacked ``(P, K, C, d)``; a slide close is ONE vmapped
+jitted launch for all partitions (bucket skyline + ring write + window-union
+skyline + compact). Under a ``mesh`` the P axis is sharded and XLA's GSPMD
+partitions the same program across devices (the kernels here are pure XLA —
+scan-based — precisely so the meshed path needs no shard_map).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skyline_tpu.bridge.wire import parse_trigger
+from skyline_tpu.metrics.tracing import NULL_TRACER
+from skyline_tpu.ops.block_skyline import skyline_mask_scan
+from skyline_tpu.ops.dispatch import skyline_keep_np
+from skyline_tpu.ops.dominance import compact
+from skyline_tpu.parallel.partitioners import partition_ids_np
+from skyline_tpu.stream.engine import EngineConfig, _QueryState
+from skyline_tpu.utils.buckets import next_pow2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _slide_step_batched(rings, ring_valids, slot, rows, rows_valid):
+    """Close one global slide across all partitions in one launch.
+
+    rings (P, K, C, d), ring_valids (P, K, C), slot scalar int32 (same ring
+    position for every partition — slides are global), rows (P, C, d)
+    padded, rows_valid (P, C). Returns (rings', ring_valids', win_sky
+    (P, K*C, d), win_valid (P, K*C), win_counts (P,)) with each partition's
+    window skyline compacted to the front of its flat buffer.
+    """
+
+    def core(ring, ring_valid, r, rv):
+        k, c, d = ring.shape
+        bucket_keep = skyline_mask_scan(r, rv)
+        bvals, bvalid, _ = compact(r, bucket_keep, c)
+        ring = ring.at[slot].set(bvals)
+        ring_valid = ring_valid.at[slot].set(bvalid)
+        flat = ring.reshape(k * c, d)
+        fvalid = ring_valid.reshape(k * c)
+        wkeep = skyline_mask_scan(flat, fvalid)
+        sky, sky_valid, count = compact(flat, wkeep, k * c)
+        return ring, ring_valid, sky, sky_valid, count.astype(jnp.int32)
+
+    return jax.vmap(core, in_axes=(0, 0, 0, 0))(rings, ring_valids, rows, rows_valid)
+
+
+class SlidingEngine:
+    """Partitioned sliding-window skyline engine (see module docstring)."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        window_size: int,
+        slide: int,
+        mesh=None,
+        emit_per_slide: bool = False,
+        tracer=None,
+    ):
+        if window_size % slide != 0:
+            raise ValueError(
+                f"window_size {window_size} must be a multiple of slide {slide}"
+            )
+        self.config = config
+        self.window_size = window_size
+        self.slide = slide
+        self.k = window_size // slide
+        self.mesh = mesh
+        self.emit_per_slide = emit_per_slide
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        P = config.num_partitions
+        # start capacity at the balanced-routing bucket (2x headroom over
+        # slide/P); grows when routing skew overflows it
+        self._cap = next_pow2(max(2 * slide // max(P, 1), 64), min_cap=128)
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = mesh.axis_names[0]
+            if P % int(mesh.shape[axis]):
+                raise ValueError(
+                    f"num_partitions {P} must be divisible by mesh axis "
+                    f"size {mesh.shape[axis]}"
+                )
+            self._sharding = NamedSharding(mesh, PartitionSpec(axis))
+        self._rings = self._put(
+            np.full((P, self.k, self._cap, config.dims), np.inf, np.float32)
+        )
+        self._ring_valids = self._put(
+            np.zeros((P, self.k, self._cap), dtype=bool)
+        )
+        # per-partition current-window skylines (device cache from the last
+        # slide close) + exact survivor counts on host
+        self._win_sky = None
+        self._win_counts = np.zeros(P, dtype=np.int64)
+        self._slot = 0
+        self._slides_closed = 0
+        # current slide's routed rows, per partition (host)
+        self._pend: list[list[np.ndarray]] = [[] for _ in range(P)]
+        self._pend_rows = np.zeros(P, dtype=np.int64)
+        self._slide_fill = 0  # tuples of the in-progress slide
+        self.records_in = 0
+        self.dropped = 0
+        self.prefiltered = 0
+        self.max_seen_id = np.full(P, -1, dtype=np.int64)
+        self.records_seen = np.zeros(P, dtype=np.int64)
+        self.start_time_ms: list[float | None] = [None] * P
+        self.processing_ns = 0
+        self._pending_queries: dict[int, list[_QueryState]] = {
+            i: [] for i in range(P)
+        }
+        self._inflight: dict[str, _QueryState] = {}
+        self._results: list[dict] = []
+
+    def _put(self, arr):
+        if self._sharding is not None:
+            return jax.device_put(arr, self._sharding)
+        return jnp.asarray(arr)
+
+    # -- data plane -------------------------------------------------------
+
+    def process_records(self, ids, values, now_ms: float | None = None) -> None:
+        """Split the batch at global slide boundaries, route each segment,
+        close slides as they fill."""
+        if values.shape[0] == 0:
+            return
+        if now_ms is None:
+            now_ms = time.time() * 1000.0
+        self.records_in += values.shape[0]
+        pos = 0
+        n = values.shape[0]
+        while pos < n:
+            take = min(self.slide - self._slide_fill, n - pos)
+            self._route(ids[pos : pos + take], values[pos : pos + take], now_ms)
+            self._slide_fill += take
+            pos += take
+            if self._slide_fill == self.slide:
+                self._close_slide(now_ms)
+                self._slide_fill = 0
+
+    def _route(self, ids, values, now_ms: float) -> None:
+        cfg = self.config
+        with self.tracer.phase("route"):
+            pids = partition_ids_np(
+                values, cfg.algo, cfg.num_partitions, cfg.domain_max
+            )
+            order = np.argsort(pids, kind="stable")
+            s_pids, s_vals, s_ids = pids[order], values[order], ids[order]
+            bounds = np.searchsorted(
+                s_pids, np.arange(cfg.num_partitions + 1)
+            )
+            for p in range(cfg.num_partitions):
+                lo, hi = bounds[p], bounds[p + 1]
+                if lo == hi:
+                    continue
+                if self.start_time_ms[p] is None:
+                    self.start_time_ms[p] = now_ms
+                self.max_seen_id[p] = max(
+                    self.max_seen_id[p], int(s_ids[lo:hi].max())
+                )
+                self.records_seen[p] += hi - lo
+                self._pend[p].append(np.array(s_vals[lo:hi]))
+                self._pend_rows[p] += hi - lo
+                now_ms = self._recheck_pending(p, now_ms)
+
+    def _close_slide(self, now_ms: float) -> None:
+        t0 = time.perf_counter_ns()
+        P = self.config.num_partitions
+        d = self.config.dims
+        max_rows = int(self._pend_rows.max())
+        if max_rows > self._cap:
+            self._grow(next_pow2(max_rows, min_cap=128))
+        rows = np.full((P, self._cap, d), np.inf, dtype=np.float32)
+        rvalid = np.zeros((P, self._cap), dtype=bool)
+        for p in range(P):
+            if self._pend[p]:
+                r = (
+                    self._pend[p][0]
+                    if len(self._pend[p]) == 1
+                    else np.concatenate(self._pend[p], axis=0)
+                )
+                rows[p, : r.shape[0]] = r
+                rvalid[p, : r.shape[0]] = True
+        self._pend = [[] for _ in range(P)]
+        self._pend_rows[:] = 0
+        with self.tracer.phase("slide/step"):
+            (
+                self._rings,
+                self._ring_valids,
+                self._win_sky,
+                _win_valid,
+                counts,
+            ) = _slide_step_batched(
+                self._rings,
+                self._ring_valids,
+                jnp.asarray(self._slot, dtype=jnp.int32),
+                self._put(rows),
+                self._put(rvalid),
+            )
+            self._win_counts = np.asarray(counts, dtype=np.int64)
+        self._slot = (self._slot + 1) % self.k
+        self._slides_closed += 1
+        self.processing_ns += time.perf_counter_ns() - t0
+        if self.emit_per_slide:
+            q = _QueryState(
+                qid=f"slide-{self._slides_closed - 1}",
+                payload=f"slide-{self._slides_closed - 1},{self.records_in}",
+                required=0,
+                dispatch_ms=now_ms,
+            )
+            self._answer_window(q, now_ms)
+
+    def _grow(self, new_cap: int) -> None:
+        """Routing skew overflowed a ring's row capacity: grow all rings
+        (rare; preserves closed buckets)."""
+        P = self.config.num_partitions
+        d = self.config.dims
+        pad = jnp.full(
+            (P, self.k, new_cap - self._cap, d), jnp.inf, dtype=jnp.float32
+        )
+        self._rings = self._put(jnp.concatenate([self._rings, pad], axis=2))
+        vpad = jnp.zeros((P, self.k, new_cap - self._cap), dtype=bool)
+        self._ring_valids = self._put(
+            jnp.concatenate([self._ring_valids, vpad], axis=2)
+        )
+        self._cap = new_cap
+
+    # -- control plane ----------------------------------------------------
+
+    def process_trigger(self, payload: str, now_ms: float | None = None) -> None:
+        if now_ms is None:
+            now_ms = time.time() * 1000.0
+        qid, required = parse_trigger(payload)
+        q = _QueryState(
+            qid=qid, payload=payload, required=required, dispatch_ms=now_ms
+        )
+        self._inflight[payload] = q
+        ready = all(
+            self.max_seen_id[p] >= required or self.max_seen_id[p] == -1
+            for p in range(self.config.num_partitions)
+        )
+        if ready:
+            self._answer_window(q, now_ms)
+        else:
+            for p in range(self.config.num_partitions):
+                if not (
+                    self.max_seen_id[p] >= required
+                    or self.max_seen_id[p] == -1
+                ):
+                    self._pending_queries[p].append(q)
+
+    def _recheck_pending(self, p: int, now_ms: float) -> float:
+        """Drop cleared barriers for partition ``p``; a query answers once
+        no partition's pending list holds it anymore. Returns the advanced
+        clock (answer merges can take real wall; later answers in the same
+        call must not time-travel before them)."""
+        still = []
+        unblocked = []
+        for q in self._pending_queries[p]:
+            if self.max_seen_id[p] >= q.required:
+                unblocked.append(q)
+            else:
+                still.append(q)
+        self._pending_queries[p] = still
+        for q in unblocked:
+            if not any(
+                q in lst for lst in self._pending_queries.values()
+            ):
+                now_ms = self._answer_window(q, now_ms)
+        return now_ms
+
+    # -- answering --------------------------------------------------------
+
+    def _current_partials(self):
+        """Per-partition current window contributions (host arrays) plus a
+        per-partition flag: does the contribution still need a local
+        skyline prune (True unless it came straight from the exact
+        window-skyline cache with no pending rows)."""
+        P = self.config.num_partitions
+        d = self.config.dims
+        parts = []
+        need_prune = [False] * P
+        if self._win_sky is not None:
+            with self.tracer.phase("query/snapshot_transfer"):
+                host = np.asarray(self._win_sky)
+            for p in range(P):
+                parts.append(host[p, : self._win_counts[p]])
+        else:
+            # _win_sky is None only before the first slide closes (_grow
+            # invalidates it, but _close_slide recomputes it in the same
+            # call before anyone can observe the gap)
+            assert self._slides_closed == 0
+            parts = [np.empty((0, d), np.float32) for _ in range(P)]
+        for p in range(P):
+            if self._pend[p]:
+                pend = np.concatenate(self._pend[p], axis=0)
+                parts[p] = np.concatenate([parts[p], pend], axis=0)
+                need_prune[p] = True
+        return parts, need_prune
+
+    def _answer_window(self, q: _QueryState, now_ms: float) -> float:
+        t0 = time.perf_counter_ns()
+        parts, need_prune = self._current_partials()
+        P = self.config.num_partitions
+        # local pass: prune each contribution to its partition's window
+        # skyline (already exact when served from the slide-close cache)
+        local = []
+        for p in range(P):
+            arr = parts[p]
+            if arr.shape[0] and need_prune[p]:
+                arr = arr[skyline_keep_np(arr)]
+            local.append(arr)
+        sizes = [a.shape[0] for a in local]
+        union = (
+            np.concatenate(local, axis=0)
+            if any(sizes)
+            else np.empty((0, self.config.dims), np.float32)
+        )
+        origins = np.concatenate(
+            [np.full(s, p, dtype=np.int32) for p, s in enumerate(sizes)]
+        ) if any(sizes) else np.empty((0,), np.int32)
+        keep = (
+            skyline_keep_np(union)
+            if union.shape[0]
+            else np.zeros((0,), dtype=bool)
+        )
+        global_sky = union[keep]
+        surv = np.bincount(origins[keep], minlength=P)
+        merge_ms = (time.perf_counter_ns() - t0) / 1e6
+        now = now_ms + merge_ms
+
+        starts = [s for s in self.start_time_ms if s is not None]
+        job_start = min(starts) if starts else now
+        local_ms = self.processing_ns / 1e6
+        map_wall = max(0.0, now_ms - job_start)
+        ratios = sum(
+            surv[p] / sizes[p] for p in range(P) if sizes[p] > 0
+        )
+        parts_payload = q.payload.split(",")
+        record_count = (
+            int(parts_payload[1])
+            if len(parts_payload) > 1
+            and parts_payload[1].strip().lstrip("-").isdigit()
+            else "unknown"
+        )
+        result = {
+            "query_id": q.qid,
+            "record_count": record_count,
+            "skyline_size": int(global_sky.shape[0]),
+            "optimality": float(ratios / P),
+            "ingestion_time_ms": int(max(0.0, map_wall - local_ms)),
+            "local_processing_time_ms": int(local_ms),
+            "global_processing_time_ms": int(merge_ms),
+            "total_processing_time_ms": int(now - job_start),
+            "query_latency_ms": int(now - q.dispatch_ms),
+            "window_size": self.window_size,
+            "slide": self.slide,
+            "slides_closed": self._slides_closed,
+            "window_filled": self._slides_closed >= self.k,
+        }
+        if self.config.emit_skyline_points:
+            result["skyline_points"] = global_sky.tolist()
+        self._results.append(result)
+        self._inflight.pop(q.payload, None)
+        return now
+
+    # -- results / observability ------------------------------------------
+
+    def poll_results(self) -> list[dict]:
+        out, self._results = self._results, []
+        return out
+
+    def check_timeouts(self, now_ms: float | None = None) -> int:
+        """Sliding triggers answer from current state; a deferred barrier
+        can still time out into a partial answer over what exists."""
+        timeout = self.config.query_timeout_ms
+        if timeout <= 0 or not self._inflight:
+            return 0
+        if now_ms is None:
+            now_ms = time.time() * 1000.0
+        overdue = [
+            q
+            for q in self._inflight.values()
+            if now_ms - q.dispatch_ms > timeout
+        ]
+        for q in overdue:
+            for lst in self._pending_queries.values():
+                if q in lst:
+                    lst.remove(q)
+            self._answer_window(q, now_ms)
+            self._results[-1]["partial"] = True
+        return len(overdue)
+
+    @property
+    def inflight_queries(self) -> int:
+        return len(self._inflight)
+
+    def stats(self, include_skyline_counts: bool = False) -> dict:
+        out = {
+            "mode": "sliding",
+            "records_in": self.records_in,
+            "dropped": self.dropped,
+            "prefiltered": self.prefiltered,
+            "inflight_queries": len(self._inflight),
+            "window_size": self.window_size,
+            "slide": self.slide,
+            "slides_closed": self._slides_closed,
+            "pending_flush_rows": int(self._pend_rows.sum()),
+            "processing_ms": self.processing_ns / 1e6,
+            "partitions": {
+                "records_seen": self.records_seen.tolist(),
+                "max_seen_id": self.max_seen_id.tolist(),
+            },
+            "meshed": self.mesh is not None,
+        }
+        if include_skyline_counts:
+            out["partitions"]["skyline_counts"] = self._win_counts.tolist()
+        return out

@@ -32,6 +32,20 @@ class JobConfig:
     bootstrap: str = "localhost:9092"
     buffer_size: int = 4096
     emit_skyline_points: bool = False
+    # engine knobs beyond the reference's flag surface (each defaults to
+    # the engine's own default so older invocations are unchanged)
+    query_timeout_ms: float = 0.0  # 0 = wait forever (reference behavior)
+    grid_prefilter: bool = False
+    initial_capacity: int = 0
+    flush_policy: str = "incremental"
+    # worker runtime knobs
+    mesh: int = 0  # >0: shard partitions over this many devices
+    stats_port: int = 0  # >0: serve /stats + /healthz on this port
+    # sliding-window mode (both 0 = unbounded/tumbling, the reference's
+    # semantics); window must be a multiple of slide
+    window_size: int = 0
+    slide: int = 0
+    emit_per_slide: bool = False
 
     def __post_init__(self):
         if self.parallelism < 1:
@@ -44,6 +58,50 @@ class JobConfig:
             raise ValueError(f"domain must be > 0, got {self.domain}")
         if self.buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {self.buffer_size}")
+        if self.query_timeout_ms < 0:
+            raise ValueError(
+                f"query_timeout_ms must be >= 0, got {self.query_timeout_ms}"
+            )
+        if self.initial_capacity < 0:
+            raise ValueError(
+                f"initial_capacity must be >= 0, got {self.initial_capacity}"
+            )
+        if self.flush_policy not in ("incremental", "lazy"):
+            raise ValueError(
+                f"flush_policy must be incremental|lazy, got {self.flush_policy!r}"
+            )
+        if self.mesh < 0:
+            raise ValueError(f"mesh must be >= 0, got {self.mesh}")
+        if self.mesh and self.flush_policy == "lazy":
+            raise ValueError("flush_policy='lazy' requires mesh=0 (single device)")
+        # the over-partitioning factor is owned by EngineConfig; validate
+        # against it rather than a duplicated literal
+        num_partitions = EngineConfig(parallelism=self.parallelism).num_partitions
+        if self.mesh and num_partitions % self.mesh:
+            raise ValueError(
+                f"num_partitions {num_partitions} must be divisible "
+                f"by mesh size {self.mesh}"
+            )
+        if (self.window_size > 0) != (self.slide > 0):
+            raise ValueError(
+                "--window and --slide must be given together (both > 0)"
+            )
+        if self.window_size and self.window_size % self.slide:
+            raise ValueError(
+                f"window_size {self.window_size} must be a multiple of "
+                f"slide {self.slide}"
+            )
+        if self.window_size and (
+            self.grid_prefilter
+            or self.flush_policy == "lazy"
+            or self.initial_capacity
+        ):
+            # the sliding engine implements none of these; failing beats
+            # an operator believing a filter is active when it is not
+            raise ValueError(
+                "sliding-window mode (--window/--slide) does not support "
+                "--grid-prefilter, --flush-policy lazy, or --initial-capacity"
+            )
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -53,7 +111,29 @@ class JobConfig:
             dims=self.dims,
             buffer_size=self.buffer_size,
             emit_skyline_points=self.emit_skyline_points,
+            query_timeout_ms=self.query_timeout_ms,
+            grid_prefilter=self.grid_prefilter,
+            initial_capacity=self.initial_capacity,
+            flush_policy=self.flush_policy,
         )
+
+    def build_mesh(self):
+        """Build the ``jax.sharding.Mesh`` this config asks for (None when
+        ``mesh`` is 0). Uses the first ``mesh`` local devices."""
+        if not self.mesh:
+            return None
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < self.mesh:
+            raise RuntimeError(
+                f"--mesh {self.mesh} requested but only {len(devs)} "
+                f"device(s) visible"
+            )
+        import numpy as _np
+
+        return Mesh(_np.array(devs[: self.mesh]), ("part",))
 
 
 def parse_job_args(argv=None) -> JobConfig:
@@ -78,6 +158,38 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=_env_int("BUFFER_SIZE", defaults.buffer_size))
     ap.add_argument("--emit-skyline-points", action="store_true",
                     default=_env_bool("EMIT_SKYLINE_POINTS"))
+    ap.add_argument("--query-timeout-ms", type=float,
+                    default=_env_float("QUERY_TIMEOUT_MS", defaults.query_timeout_ms),
+                    help="finalize overdue queries as partial results after "
+                         "this long (0 = wait forever, reference behavior)")
+    ap.add_argument("--grid-prefilter", action="store_true",
+                    default=_env_bool("GRID_PREFILTER"),
+                    help="drop tuples dominated by the domain midpoint "
+                         "(the reference's disabled GridDominanceFilter, "
+                         "implemented barrier-safely)")
+    ap.add_argument("--initial-capacity", type=int,
+                    default=_env_int("INITIAL_CAPACITY", defaults.initial_capacity),
+                    help="pre-size per-partition skyline buffers")
+    ap.add_argument("--flush-policy", choices=("incremental", "lazy"),
+                    default=os.environ.get("SKYLINE_FLUSH_POLICY",
+                                           defaults.flush_policy))
+    ap.add_argument("--mesh", type=int, default=_env_int("MESH", defaults.mesh),
+                    help="shard the partition state over this many devices "
+                         "(0 = single device)")
+    ap.add_argument("--stats-port", type=int,
+                    default=_env_int("STATS_PORT", defaults.stats_port),
+                    help="serve live /stats JSON on this port (0 = off)")
+    ap.add_argument("--window", type=int, dest="window_size",
+                    default=_env_int("WINDOW", defaults.window_size),
+                    help="sliding-window size in tuples (0 = unbounded, "
+                         "the reference's semantics)")
+    ap.add_argument("--slide", type=int,
+                    default=_env_int("SLIDE", defaults.slide),
+                    help="slide in tuples (with --window)")
+    ap.add_argument("--emit-per-slide", action="store_true",
+                    default=_env_bool("EMIT_PER_SLIDE"),
+                    help="emit one result JSON per completed slide in "
+                         "addition to trigger-driven results")
     a = ap.parse_args(argv)
     return JobConfig(
         parallelism=a.parallelism,
@@ -90,6 +202,15 @@ def parse_job_args(argv=None) -> JobConfig:
         bootstrap=a.bootstrap,
         buffer_size=a.buffer_size,
         emit_skyline_points=a.emit_skyline_points,
+        query_timeout_ms=a.query_timeout_ms,
+        grid_prefilter=a.grid_prefilter,
+        initial_capacity=a.initial_capacity,
+        flush_policy=a.flush_policy,
+        mesh=a.mesh,
+        stats_port=a.stats_port,
+        window_size=a.window_size,
+        slide=a.slide,
+        emit_per_slide=a.emit_per_slide,
     )
 
 

@@ -178,6 +178,30 @@ def test_device_fast_path_matches_straggler_path(rng):
     )
 
 
+def test_timing_invariant_straggler_midcall_answers(rng):
+    # Round-3 regression (second deploy-artifact violation): a deferred
+    # query whose barriers clear DURING one process_records call — the
+    # first partition's snapshot flush (incl. compile) takes real wall that
+    # later partitions' arrivals must not predate. Injected constant clocks
+    # make any lost wall time break total >= local deterministically.
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, algo="mr-dim", dims=7, buffer_size=500000)
+    )
+    x = rng.uniform(0, 1000, size=(30000, 7)).astype(np.float32)
+    ids = np.arange(x.shape[0], dtype=np.int64)
+    eng.process_records(ids[:20000], x[:20000], now_ms=1000.0)
+    eng.process_trigger("0,25000", now_ms=1500.0)  # defers on all partitions
+    assert eng.poll_results() == []
+    # one call clears every barrier; all flush work lands in the first
+    # partition's snapshot inside this call
+    eng.process_records(ids[20000:], x[20000:], now_ms=2000.0)
+    (r,) = eng.poll_results()
+    assert r["local_processing_time_ms"] > 0
+    assert r["total_processing_time_ms"] >= r["local_processing_time_ms"]
+    assert r["total_processing_time_ms"] >= r["global_processing_time_ms"]
+    assert r["ingestion_time_ms"] >= 0
+
+
 def test_timing_decomposition_invariant(rng):
     # Regression (round-2 deploy artifact: LocalTime 3713 > TotalTime 2660):
     # trigger-time snapshot flush wall (incl. first-query jit compile) must

@@ -1,0 +1,120 @@
+"""Worker-surface features reachable from the shell: stats endpoint, meshed
+worker, watchdog + engine knobs via JobConfig/CLI flags."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.bridge import MemoryBus, SkylineWorker
+from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+from skyline_tpu.ops import skyline_np
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.utils.config import JobConfig, parse_job_args
+from skyline_tpu.workload.generators import anti_correlated
+
+
+def test_jobconfig_cli_covers_engine_knobs():
+    cfg = parse_job_args(
+        [
+            "--query-timeout-ms", "2500",
+            "--grid-prefilter",
+            "--initial-capacity", "4096",
+            "--flush-policy", "lazy",
+            "--stats-port", "0",
+        ]
+    )
+    ec = cfg.engine_config()
+    assert ec.query_timeout_ms == 2500
+    assert ec.grid_prefilter is True
+    assert ec.initial_capacity == 4096
+    assert ec.flush_policy == "lazy"
+
+
+def test_jobconfig_validation():
+    with pytest.raises(ValueError):
+        JobConfig(flush_policy="bogus")
+    with pytest.raises(ValueError):
+        JobConfig(mesh=2, flush_policy="lazy")
+    with pytest.raises(ValueError):
+        JobConfig(mesh=3, parallelism=4)  # 8 partitions % 3 != 0
+    with pytest.raises(ValueError):
+        JobConfig(query_timeout_ms=-1)
+
+
+def test_stats_endpoint_serves_live_counters(rng):
+    bus = MemoryBus()
+    worker = SkylineWorker(
+        bus,
+        EngineConfig(parallelism=2, algo="mr-angle", dims=2,
+                     domain_max=10000.0, buffer_size=256),
+        stats_port=0,  # pick a free port
+    )
+    import urllib.error
+    try:
+        port = worker.stats_server.port
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            assert json.load(r) == {"ok": True}
+        x = anti_correlated(rng, 2000, 2, 0, 10000)
+        bus.produce_many(
+            "input-tuples",
+            [format_tuple_line(i, row) for i, row in enumerate(x)],
+        )
+        bus.produce("queries", format_trigger(0, 0))
+        while worker.step() > 0:
+            pass
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats") as r:
+            stats = json.load(r)
+        assert stats["records_in"] == 2000
+        assert stats["results_emitted"] == 1
+        assert stats["inflight_queries"] == 0
+        assert len(stats["partitions"]["records_seen"]) == 4
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+        assert exc.value.code == 404
+    finally:
+        worker.close()
+
+
+def test_meshed_worker_end_to_end(rng):
+    # --mesh N from the shell: partition state sharded over N virtual
+    # devices, full transport->result plane, exact result
+    cfg = parse_job_args(["--parallelism", "2", "--dims", "2",
+                          "--domain", "10000", "--mesh", "2"])
+    mesh = cfg.build_mesh()
+    assert mesh is not None and mesh.devices.size == 2
+    bus = MemoryBus()
+    worker = SkylineWorker(bus, cfg.engine_config(), mesh=mesh)
+    x = anti_correlated(rng, 3000, 2, 0, 10000)
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, row) for i, row in enumerate(x)],
+    )
+    bus.produce("queries", format_trigger(0, 0))
+    while worker.step() > 0:
+        pass
+    (line,) = bus.consumer("output-skyline", from_beginning=True).poll()
+    result = json.loads(line)
+    assert result["skyline_size"] == skyline_np(x).shape[0]
+
+
+def test_watchdog_reachable_from_cli(rng):
+    # --query-timeout-ms wires through to partial-result finalization
+    cfg = parse_job_args(["--parallelism", "1", "--dims", "2",
+                          "--query-timeout-ms", "1"])
+    bus = MemoryBus()
+    worker = SkylineWorker(bus, cfg.engine_config())
+    bus.produce_many("input-tuples", ["0,5.0,5.0"])
+    # barrier at id 10 never clears on a silent stream
+    bus.produce("queries", format_trigger(7, 10))
+    while worker.step() > 0:
+        pass
+    import time
+
+    time.sleep(0.05)  # let the 1 ms timeout lapse
+    worker.step()
+    (line,) = bus.consumer("output-skyline", from_beginning=True).poll()
+    result = json.loads(line)
+    assert result["partial"] is True
+    assert result["missing_partitions"]
