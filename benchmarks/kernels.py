@@ -60,16 +60,17 @@ def bench_mask_kernels(reps: int, d: int, results: dict) -> None:
     rng = np.random.default_rng(0)
 
     variants: list[tuple[str, object, list[int]]] = [
-        ("skyline_mask_dense", lambda xv: skyline_mask(xv), [4096, 8192]),
+        ("skyline_mask_dense", lambda xv: skyline_mask(xv),
+         [4096, 8192] if on_tpu else [4096]),
         (
             "skyline_mask_scan",
             lambda xv: skyline_mask_scan(xv),
-            [16384, 65536, 262144],
+            [16384, 65536, 262144] if on_tpu else [16384],
         ),
         (
             "skyline_mask_blocked",
             lambda xv: skyline_mask_blocked(xv),
-            [16384, 65536],
+            [16384, 65536] if on_tpu else [16384],
         ),
     ]
     if on_tpu:
@@ -114,7 +115,7 @@ def bench_flush_step(reps: int, d: int, results: dict) -> None:
     rng = np.random.default_rng(1)
     P, cap, B = 8, 65536, 8192
     if not on_tpu:
-        cap, B = 16384, 2048  # CPU would take minutes at TPU shapes
+        cap, B = 8192, 1024  # CPU would take minutes at TPU shapes
 
     # a realistic running skyline: the skyline of an anti-correlated draw,
     # padded into the capacity buffer (valid fraction ~cap/2)
@@ -186,7 +187,7 @@ def bench_sfs(reps: int, d: int, results: dict) -> None:
     from skyline_tpu.ops.block_skyline import skyline_large
     from skyline_tpu.workload.generators import anti_correlated
 
-    sizes = [262144, 1_000_000] if jax.default_backend() == "tpu" else [262144]
+    sizes = [262144, 1_000_000] if jax.default_backend() == "tpu" else [65536]
     rng = np.random.default_rng(3)
     for n in sizes:
         x = anti_correlated(rng, n, d, 0, 10000)
@@ -242,6 +243,11 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the env var alone does not stop the axon plugin from initializing
+        # (and hanging when the tunnel is down); the config update does
+        jax.config.update("jax_platforms", "cpu")
 
     results: dict = {}
     meta = {

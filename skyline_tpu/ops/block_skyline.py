@@ -113,8 +113,9 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
     ``nb`` sequential steps of one (chunk, N) tile each — an order of
     magnitude fewer dispatches than the (nb^2)-step nested scan in
     ``skyline_mask_blocked``, which is latency-bound on TPU for N ~ 10^5
-    (measured 17 s -> ~2 s on the 8-D global merge). Peak per-step memory is
-    one (chunk, N) bool tile, so ``chunk`` shrinks automatically as N grows.
+    (see artifacts/kernels_tpu.json for the measured scan-vs-blocked-vs-
+    Pallas table). Peak per-step memory is one (chunk, N) bool tile, so
+    ``chunk`` shrinks automatically as N grows.
     """
     n, d = x.shape
     if valid is None:

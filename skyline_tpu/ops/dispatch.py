@@ -1,8 +1,10 @@
 """Backend dispatch for the dominance hot ops.
 
-On TPU the Pallas kernels (VMEM-tiled, triangular-skip) are ~4x the XLA scan
-kernel; on CPU (tests, virtual meshes) Pallas would need interpret mode, so
-the scan kernel is used. Resolution happens once at first call.
+On TPU the Pallas kernel (VMEM-tiled, min/max cascade, triangular skip) is
+the fast path — see artifacts/kernels_tpu.json (benchmarks/kernels.py) for
+the measured Pallas-vs-scan table at several N. On CPU (tests, virtual
+meshes) Pallas would need interpret mode, so the scan kernel is used.
+Resolution happens once at first call.
 """
 
 from __future__ import annotations

@@ -74,6 +74,27 @@ class EngineConfig:
         return 2 * self.parallelism
 
 
+def echo_record_count(payload: str):
+    """The reference echoes the payload's second field as record_count
+    (FlinkSkyline.java:640-642) — emitting the literal string, which for a
+    count-less payload would produce invalid JSON (unquoted `unknown`); we
+    quote it instead. Shared by both engine modes."""
+    parts = payload.split(",")
+    if len(parts) > 1 and parts[1].strip().lstrip("-").isdigit():
+        return int(parts[1])
+    return "unknown"
+
+
+def optimality_mean(survivors, sizes, num_partitions: int) -> float:
+    """Mean over ALL partitions of survivors_i / localSize_i, empty
+    partitions contributing 0 (FlinkSkyline.java:592-608)."""
+    ratios = 0.0
+    for surv, size in zip(survivors, sizes):
+        if size > 0:
+            ratios += surv / size
+    return ratios / num_partitions
+
+
 @dataclass
 class _QueryState:
     """Aggregator state for one in-flight query (FlinkSkyline.java:490-495)."""
@@ -335,14 +356,11 @@ class SkylineEngine:
         total_ms = now - job_start
         latency_ms = now - q.dispatch_ms
 
-        # optimality: mean over ALL partitions of survivors_i / localSize_i,
-        # empty partitions contributing 0 (FlinkSkyline.java:592-608)
-        ratios = 0.0
-        for p in pids_order:
-            size = q.local_sizes[p]
-            if size > 0:
-                ratios += survivors_per_pid[p] / size
-        optimality = ratios / self.config.num_partitions
+        optimality = optimality_mean(
+            [survivors_per_pid[p] for p in pids_order],
+            [q.local_sizes[p] for p in pids_order],
+            self.config.num_partitions,
+        )
 
         self._emit_result(
             q,
@@ -371,15 +389,9 @@ class SkylineEngine:
         points=None,
         partial_missing=None,
     ) -> None:
-        # record_count is echoed from the payload's second field; the
-        # reference emits the literal string (FlinkSkyline.java:640-642),
-        # which for a count-less payload would produce invalid JSON
-        # (unquoted `unknown`) — we quote it instead.
-        parts = q.payload.split(",")
-        record_count = int(parts[1]) if len(parts) > 1 and parts[1].strip().lstrip("-").isdigit() else "unknown"
         result = {
             "query_id": q.qid,
-            "record_count": record_count,
+            "record_count": echo_record_count(q.payload),
             "skyline_size": skyline_size,
             "optimality": optimality,
             "ingestion_time_ms": int(ingestion),
@@ -423,14 +435,10 @@ class SkylineEngine:
         job_start = min(starts) if starts else now
         local_ms = self.pset.processing_ms
         map_wall = max(0.0, map_finish - job_start)
-        ratios = 0.0
-        for p in range(self.config.num_partitions):
-            if counts[p] > 0:
-                ratios += surv[p] / counts[p]
         self._emit_result(
             q,
             skyline_size=g,
-            optimality=ratios / self.config.num_partitions,
+            optimality=optimality_mean(surv, counts, self.config.num_partitions),
             ingestion=max(0.0, map_wall - local_ms),
             local_ms=local_ms,
             global_ms=now - map_finish,

@@ -41,7 +41,12 @@ from skyline_tpu.ops.block_skyline import skyline_mask_scan
 from skyline_tpu.ops.dispatch import skyline_keep_np
 from skyline_tpu.ops.dominance import compact
 from skyline_tpu.parallel.partitioners import partition_ids_np
-from skyline_tpu.stream.engine import EngineConfig, _QueryState
+from skyline_tpu.stream.engine import (
+    EngineConfig,
+    _QueryState,
+    echo_record_count,
+    optimality_mean,
+)
 from skyline_tpu.utils.buckets import next_pow2
 
 
@@ -118,6 +123,7 @@ class SlidingEngine:
         # per-partition current-window skylines (device cache from the last
         # slide close) + exact survivor counts on host
         self._win_sky = None
+        self._win_host: np.ndarray | None = None  # host cache of _win_sky
         self._win_counts = np.zeros(P, dtype=np.int64)
         self._slot = 0
         self._slides_closed = 0
@@ -155,16 +161,23 @@ class SlidingEngine:
         self.records_in += values.shape[0]
         pos = 0
         n = values.shape[0]
+        # now_ms advances through routing answers and slide closes: wall
+        # spent in either (merge compile, slide-step kernels) must be seen
+        # by later answers in the same call or total < local becomes
+        # possible (the same invariant SkylineEngine threads through
+        # _recheck_pending/_answer)
         while pos < n:
             take = min(self.slide - self._slide_fill, n - pos)
-            self._route(ids[pos : pos + take], values[pos : pos + take], now_ms)
+            now_ms = self._route(
+                ids[pos : pos + take], values[pos : pos + take], now_ms
+            )
             self._slide_fill += take
             pos += take
             if self._slide_fill == self.slide:
-                self._close_slide(now_ms)
+                now_ms = self._close_slide(now_ms)
                 self._slide_fill = 0
 
-    def _route(self, ids, values, now_ms: float) -> None:
+    def _route(self, ids, values, now_ms: float) -> float:
         cfg = self.config
         with self.tracer.phase("route"):
             pids = partition_ids_np(
@@ -188,8 +201,9 @@ class SlidingEngine:
                 self._pend[p].append(np.array(s_vals[lo:hi]))
                 self._pend_rows[p] += hi - lo
                 now_ms = self._recheck_pending(p, now_ms)
+        return now_ms
 
-    def _close_slide(self, now_ms: float) -> None:
+    def _close_slide(self, now_ms: float) -> float:
         t0 = time.perf_counter_ns()
         P = self.config.num_partitions
         d = self.config.dims
@@ -224,9 +238,12 @@ class SlidingEngine:
                 self._put(rvalid),
             )
             self._win_counts = np.asarray(counts, dtype=np.int64)
+        self._win_host = None  # device cache replaced; host copy is stale
         self._slot = (self._slot + 1) % self.k
         self._slides_closed += 1
-        self.processing_ns += time.perf_counter_ns() - t0
+        step_ns = time.perf_counter_ns() - t0
+        self.processing_ns += step_ns
+        now_ms = now_ms + step_ns / 1e6  # the close's wall advances the clock
         if self.emit_per_slide:
             q = _QueryState(
                 qid=f"slide-{self._slides_closed - 1}",
@@ -234,7 +251,8 @@ class SlidingEngine:
                 required=0,
                 dispatch_ms=now_ms,
             )
-            self._answer_window(q, now_ms)
+            now_ms = self._answer_window(q, now_ms)
+        return now_ms
 
     def _grow(self, new_cap: int) -> None:
         """Routing skew overflowed a ring's row capacity: grow all rings
@@ -307,10 +325,11 @@ class SlidingEngine:
         parts = []
         need_prune = [False] * P
         if self._win_sky is not None:
-            with self.tracer.phase("query/snapshot_transfer"):
-                host = np.asarray(self._win_sky)
+            if self._win_host is None:
+                with self.tracer.phase("query/snapshot_transfer"):
+                    self._win_host = np.asarray(self._win_sky)
             for p in range(P):
-                parts.append(host[p, : self._win_counts[p]])
+                parts.append(self._win_host[p, : self._win_counts[p]])
         else:
             # _win_sky is None only before the first slide closes (_grow
             # invalidates it, but _close_slide recomputes it in the same
@@ -359,21 +378,11 @@ class SlidingEngine:
         job_start = min(starts) if starts else now
         local_ms = self.processing_ns / 1e6
         map_wall = max(0.0, now_ms - job_start)
-        ratios = sum(
-            surv[p] / sizes[p] for p in range(P) if sizes[p] > 0
-        )
-        parts_payload = q.payload.split(",")
-        record_count = (
-            int(parts_payload[1])
-            if len(parts_payload) > 1
-            and parts_payload[1].strip().lstrip("-").isdigit()
-            else "unknown"
-        )
         result = {
             "query_id": q.qid,
-            "record_count": record_count,
+            "record_count": echo_record_count(q.payload),
             "skyline_size": int(global_sky.shape[0]),
-            "optimality": float(ratios / P),
+            "optimality": optimality_mean(surv, sizes, P),
             "ingestion_time_ms": int(max(0.0, map_wall - local_ms)),
             "local_processing_time_ms": int(local_ms),
             "global_processing_time_ms": int(merge_ms),

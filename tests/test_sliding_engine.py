@@ -141,6 +141,30 @@ def test_sliding_meshed_matches_unmeshed(rng):
     )
 
 
+def test_sliding_timing_invariant_with_midcall_close(rng):
+    # mirror of the SkylineEngine straggler-clock regression: one
+    # process_records call closes a slide (first jit compile, seconds of
+    # wall into processing_ns) AND answers a deferred query afterwards;
+    # with injected constant clocks any lost wall breaks total >= local
+    eng = SlidingEngine(
+        EngineConfig(parallelism=2, algo="mr-grid", dims=6, domain_max=1000.0),
+        window_size=4000,
+        slide=2000,
+    )
+    x = rng.uniform(0, 1000, size=(5000, 6)).astype(np.float32)
+    ids = np.arange(x.shape[0], dtype=np.int64)
+    eng.process_records(ids[:1500], x[:1500], now_ms=1000.0)
+    eng.process_trigger("0,4000", now_ms=1500.0)  # defers
+    assert eng.poll_results() == []
+    # this call closes two slides (compiles) then clears the barrier
+    eng.process_records(ids[1500:], x[1500:], now_ms=2000.0)
+    (r,) = eng.poll_results()
+    assert r["local_processing_time_ms"] > 0
+    assert r["total_processing_time_ms"] >= r["local_processing_time_ms"]
+    assert r["total_processing_time_ms"] >= r["global_processing_time_ms"]
+    assert r["ingestion_time_ms"] >= 0
+
+
 def test_sliding_worker_e2e_to_collector_csv(rng, tmp_path):
     # the full plane: producer lines -> bus -> sliding worker -> collector
     bus = MemoryBus()
