@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from skyline_tpu.metrics.collector import append_result_row
 from skyline_tpu.stream import EngineConfig, SkylineEngine
-from skyline_tpu.stream.sliding import SlidingSkyline
+from skyline_tpu.stream.sliding_engine import SlidingEngine
 from skyline_tpu.workload.generators import generate
 
 CONFIGS = [
@@ -39,10 +39,10 @@ CONFIGS = [
 SLIDING_CONFIG = ("sliding_4d_anticorrelated", "anti_correlated", 4, 200_000, 50_000)
 
 
-def run_tumbling(name, dist, dims, algo, n, outdir):
+def run_tumbling(name, dist, dims, algo, n, outdir, policy="lazy"):
     rng = np.random.default_rng(0)
     cfg = EngineConfig(parallelism=4, algo=algo, dims=dims, domain_max=10000.0,
-                       buffer_size=4096)
+                       buffer_size=8192, flush_policy=policy)
     eng = SkylineEngine(cfg)
     x = generate(dist, rng, n, dims, 0, 10000)
     ids = np.arange(n, dtype=np.int64)
@@ -67,16 +67,26 @@ def run_tumbling(name, dist, dims, algo, n, outdir):
 
 
 def run_sliding(name, dist, dims, window, slide, outdir):
+    """Sliding config through the first-class SlidingEngine (worker-grade
+    path: routing, bucket rings, per-slide results, collector CSV)."""
     rng = np.random.default_rng(0)
-    sw = SlidingSkyline(window, slide, dims)
+    eng = SlidingEngine(
+        EngineConfig(parallelism=4, algo="mr-angle", dims=dims,
+                     domain_max=10000.0),
+        window_size=window, slide=slide, emit_per_slide=True,
+    )
     n = window * 4  # several full-overlap slides
     x = generate(dist, rng, n, dims, 0, 10000)
+    ids = np.arange(n, dtype=np.int64)
     t0 = time.perf_counter()
     results = []
     for i in range(0, n, 65536):
-        results.extend(sw.push(x[i : i + 65536]))
+        eng.process_records(ids[i : i + 65536], x[i : i + 65536])
+        results.extend(eng.poll_results())
     dt = time.perf_counter() - t0
-    sizes = [r["skyline"].shape[0] for r in results if r["window_filled"]]
+    for r in results:
+        append_result_row(os.path.join(outdir, f"{name}.csv"), r)
+    sizes = [r["skyline_size"] for r in results if r["window_filled"]]
     return {
         "config": name,
         "n": n,
@@ -94,13 +104,29 @@ def main(argv=None):
     ap.add_argument("--scale", type=float, default=0.1)
     ap.add_argument("--outdir", default="bench_out")
     ap.add_argument("--only", help="substring filter on config names")
+    ap.add_argument("--policy", choices=("incremental", "lazy"),
+                    default="lazy",
+                    help="tumbling-config flush policy (lazy = SFS at query)")
     a = ap.parse_args(argv)
+    import jax
+
+    # belt and braces with the env var: JAX_PLATFORMS=cpu alone has been
+    # observed to still initialize the axon TPU plugin (which hangs when
+    # the tunnel is down); the config update actually pins the backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     os.makedirs(a.outdir, exist_ok=True)
     for name, dist, dims, algo, n in CONFIGS:
         if a.only and a.only not in name:
             continue
         out = run_tumbling(name, dist, dims, algo, max(10_000, int(n * a.scale)),
-                           a.outdir)
+                           a.outdir, policy=a.policy)
         print(json.dumps(out))
     name, dist, dims, window, slide = SLIDING_CONFIG
     if not a.only or a.only in name:

@@ -41,17 +41,30 @@ class _PartitionLog:
 
         CRC is NOT verified here: consumers verify on decode, and for the
         in-process producer the checksum was computed a microsecond ago —
-        re-verifying would just double the data plane's checksum cost."""
-        records = P.decode_record_batches(batch_bytes, verify_crc=False)
-        if not records:
+        re-verifying would just double the data plane's checksum cost.
+        Record counting reads only the fixed-offset header fields
+        (numRecords at byte 57 of each batch, per the v2 layout) — a full
+        record decode per produce would make the broker's data plane pay
+        the parse cost twice."""
+        spans = list(P.iter_batch_spans(batch_bytes))
+        n_records = sum(cnt for _, _, cnt in spans)
+        if not n_records:
             return self.next_offset
         with self.lock:
             base = self.next_offset
-            # rewrite baseOffset in place (first 8 bytes); crc does not
-            # cover it, so no re-checksum is needed — exactly why the v2
-            # format excludes baseOffset from the crc
-            stamped = struct.pack(">q", base) + batch_bytes[8:]
-            last = base + len(records) - 1
+            # rewrite each batch's baseOffset in place (first 8 bytes of a
+            # batch); crc does not cover it, so no re-checksum is needed —
+            # exactly why the v2 format excludes baseOffset from the crc.
+            # Multi-batch record sets (legal from real clients) restamp
+            # every batch so fetch offsets stay monotonic.
+            parts = []
+            off = base
+            for start, length, cnt in spans:
+                parts.append(struct.pack(">q", off))
+                parts.append(batch_bytes[start + 8 : start + length])
+                off += cnt
+            stamped = b"".join(parts)
+            last = base + n_records - 1
             self.batches.append((base, last, stamped))
             self.next_offset = last + 1
             return base

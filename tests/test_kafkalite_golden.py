@@ -178,6 +178,41 @@ def test_produce_v3_request_body_parses_by_spec():
     assert r.remaining() == 0
 
 
+def test_count_records_clamps_malformed_headers():
+    # negative batchLength must not spin forever; negative numRecords must
+    # not count backwards (broker DoS hardening)
+    bad_len = b"\x00" * 8 + struct.pack(">i", -12) + b"\x00" * 49
+    assert P.count_records(bad_len) == 0
+    good = _golden_batch([(None, b"a"), (None, b"b")], 0, 0)
+    neg_records = bytearray(good)
+    struct.pack_into(">i", neg_records, 57, -5)
+    assert P.count_records(bytes(neg_records)) == 0
+    assert P.count_records(good) == 2
+    # truncated tail after a good batch is ignored, not an error
+    assert P.count_records(good + good[:20]) == 2
+
+
+def test_broker_restamps_every_batch_in_multibatch_set():
+    from skyline_tpu.bridge.kafkalite.broker import _PartitionLog
+
+    log = _PartitionLog()
+    # a record set of TWO concatenated batches, both claiming baseOffset 0
+    blob = _golden_batch([(None, b"r0"), (None, b"r1")], 0, 0) + _golden_batch(
+        [(None, b"r2")], 0, 0
+    )
+    base = log.append(blob)
+    assert base == 0 and log.next_offset == 3
+    stored = log.read_from(0, 1 << 20)
+    assert P.decode_record_batches(stored) == [
+        (0, None, b"r0"),
+        (1, None, b"r1"),
+        (2, None, b"r2"),
+    ]
+    # appending again continues the offsets monotonically
+    log.append(_golden_batch([(None, b"r3")], 0, 0))
+    assert [o for o, _, _ in P.decode_record_batches(log.read_from(0, 1 << 20))] == [0, 1, 2, 3]
+
+
 def test_zigzag_varint_spec_values():
     # spec: zigzag maps 0,-1,1,-2,2 -> 0,1,2,3,4
     for v, wire in [(0, b"\x00"), (-1, b"\x01"), (1, b"\x02"),
