@@ -1,0 +1,42 @@
+#!/bin/bash
+# Full TPU measurement matrix for the round's evidence artifacts.
+# Run from the repo root once the TPU tunnel is reachable:
+#   bash scripts/tpu_matrix.sh [logfile]
+# Produces: artifacts/kernels_tpu.json, artifacts/bench_tpu.json,
+#   artifacts/baseline_matrix.jsonl (+ bench_out_tpu/*.csv),
+#   artifacts/reference_grid.json + overlay PNGs,
+#   artifacts/e2e_transport.json
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-artifacts/tpu_matrix.log}"
+mkdir -p artifacts
+exec >> "$LOG" 2>&1
+
+echo "=== tpu_matrix start $(date -u +%FT%TZ)"
+
+echo "--- [1/5] kernel microbench"
+timeout 2400 python benchmarks/kernels.py --reps 5 --out artifacts/kernels_tpu.json \
+  || echo "KERNELS FAILED rc=$?"
+
+echo "--- [2/5] north-star bench"
+timeout 3600 python bench.py > artifacts/bench_tpu.json \
+  || echo "BENCH FAILED rc=$?"
+tail -c 600 artifacts/bench_tpu.json; echo
+
+echo "--- [3/5] BASELINE matrix (scale 1)"
+timeout 10800 python benchmarks/run_configs.py --scale 1 --outdir bench_out_tpu \
+  > artifacts/baseline_matrix.jsonl \
+  || echo "RUN_CONFIGS FAILED rc=$?"
+cat artifacts/baseline_matrix.jsonl
+
+echo "--- [4/5] reference grid + overlay figures"
+timeout 7200 python benchmarks/reference_grid.py --n 1000000 \
+  --outdir bench_out_tpu --figdir artifacts \
+  || echo "GRID FAILED rc=$?"
+
+echo "--- [5/5] transport-inclusive e2e (2D + 8D, 1M)"
+timeout 7200 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8 \
+  --out artifacts/e2e_transport.json --log-dir deploy_logs_e2e \
+  || echo "E2E FAILED rc=$?"
+
+echo "=== tpu_matrix done $(date -u +%FT%TZ)"

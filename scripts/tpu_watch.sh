@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll for TPU recovery; when jax.devices() answers, run the matrix.
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+echo "watch start $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+while true; do
+  if timeout 70 python -c "import jax; assert jax.default_backend() == 'tpu'; print(jax.devices())" >> artifacts/tpu_watch.log 2>&1; then
+    echo "TPU BACK $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+    bash scripts/tpu_matrix.sh artifacts/tpu_matrix.log
+    echo "matrix finished $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+    exit 0
+  fi
+  sleep 240
+done

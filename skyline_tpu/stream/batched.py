@@ -37,11 +37,10 @@ from skyline_tpu.ops.dispatch import on_tpu
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
     _MIN_CAP,
-    _merge_step_batched,
-    _merge_step_pallas_batched,
     _next_pow2,
     global_merge_stats_device,
     global_points_device,
+    merge_step_active,
     meshed_merge_step,
     sfs_cleanup,
     sfs_round,
@@ -264,13 +263,27 @@ class PartitionSet:
                         self.sky, self.sky_valid, batch_dev, bvalid_dev
                     )
                 else:
-                    merge = (
-                        _merge_step_pallas_batched
-                        if on_tpu()
-                        else _merge_step_batched
+                    # active-prefix merge: dominance passes + compact run
+                    # over the live-count bucket, not the storage capacity.
+                    # out_active = _next_pow2((count_ub+widths).max()) <=
+                    # out_cap (computed from the same post-sync bounds
+                    # above) and >= active, so no further clamping needed.
+                    active = min(
+                        self._cap,
+                        _next_pow2(max(int(self._count_ub.max()), 1)),
                     )
-                    self.sky, self.sky_valid, self._count_dev = merge(
-                        self.sky, self.sky_valid, batch_dev, bvalid_dev, out_cap
+                    out_active = _next_pow2(
+                        max(int((self._count_ub + widths).max()), 1)
+                    )
+                    self.sky, self.sky_valid, self._count_dev = (
+                        merge_step_active(
+                            self.sky,
+                            self.sky_valid,
+                            batch_dev,
+                            bvalid_dev,
+                            active,
+                            out_active,
+                        )
                     )
                 if self.tracer.sync_device:
                     # profiling mode: attribute the async kernel here instead

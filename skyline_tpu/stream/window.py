@@ -113,6 +113,46 @@ _merge_step_pallas_batched = jax.jit(
 )
 
 
+@functools.partial(jax.jit, static_argnames=("active", "out_active"))
+def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: int):
+    """Incremental flush step over the ACTIVE capacity prefix only.
+
+    A pre-sized or previously-grown buffer makes the plain batched merge pay
+    full-capacity dominance passes and a full-buffer compact argsort on
+    every flush, even when the live skylines are a fraction of capacity.
+    This variant slices the dominator/compact work to ``active`` (the
+    capacity bucket of the current max count; rows past it are guaranteed
+    invalid) and compacts into ``out_active`` (the bucket covering counts +
+    this batch), then pads back out to the storage capacity — one fused
+    launch, same storage shape out. Requires out_active >= active and
+    out_active >= per-partition count + batch rows (the caller's capacity
+    bookkeeping guarantees both). Single-device only (the meshed path keeps
+    ``meshed_merge_step``).
+    """
+    from skyline_tpu.ops.dispatch import on_tpu
+
+    P, cap, d = sky.shape
+    core = _merge_step_pallas_core if on_tpu() else _merge_step_core
+    sky_a = lax.slice(sky, (0, 0, 0), (P, active, d))
+    val_a = lax.slice(sky_valid, (0, 0), (P, active))
+    vals, valid, cnt = jax.vmap(
+        lambda s, sv, b, bv: core(s, sv, b, bv, out_active)
+    )(sky_a, val_a, batch, bvalid)
+    out_cap = max(cap, out_active)
+    if out_active < out_cap:
+        vals = jnp.concatenate(
+            [
+                vals,
+                jnp.full((P, out_cap - out_active, d), jnp.inf, vals.dtype),
+            ],
+            axis=1,
+        )
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((P, out_cap - out_active), dtype=bool)], axis=1
+        )
+    return vals, valid, cnt.astype(jnp.int32)
+
+
 # --------------------------------------------------------------------------
 # SFS (sort-filter-skyline) rounds: the lazy flush policy's kernel.
 #

@@ -39,6 +39,7 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
             "query_timeout_ms": cfg.query_timeout_ms,
             "grid_prefilter": cfg.grid_prefilter,
             "initial_capacity": cfg.initial_capacity,
+            "flush_policy": cfg.flush_policy,
         },
         "records_in": engine.records_in,
         "dropped": engine.dropped,

@@ -117,3 +117,32 @@ def test_checkpoint_preserves_all_config_flags(rng, tmp_path):
     save_engine(eng, path)
     restored = load_engine(path)
     assert restored.config == cfg
+
+
+def test_checkpoint_lazy_policy_roundtrip(rng, tmp_path):
+    # a lazy-policy engine (unflushed window accumulated on host) must
+    # restore with its policy AND its pending rows intact, and answer the
+    # same query identically
+    from skyline_tpu.ops import skyline_np
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    cfg = EngineConfig(parallelism=2, algo="mr-angle", dims=3,
+                       domain_max=1000.0, flush_policy="lazy",
+                       emit_skyline_points=True)
+    eng = SkylineEngine(cfg)
+    x = rng.uniform(0, 1000, size=(4000, 3)).astype(np.float32)
+    ids = np.arange(4000, dtype=np.int64)
+    eng.process_records(ids[:2500], x[:2500])
+    path = str(tmp_path / "lazy.npz")
+    save_engine(eng, path)
+    restored = load_engine(path)
+    assert restored.config.flush_policy == "lazy"
+    assert restored.pset.flush_policy == "lazy"
+    restored.process_records(ids[2500:], x[2500:])
+    restored.process_trigger("0,0")
+    (r,) = restored.poll_results()
+    oracle = skyline_np(x)
+    assert r["skyline_size"] == oracle.shape[0]
+    got = np.asarray(r["skyline_points"])
+    assert set(map(tuple, got.round(3))) == set(map(tuple, oracle.round(3)))
