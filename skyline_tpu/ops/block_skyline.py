@@ -14,7 +14,11 @@ Two tiers above the dense tile kernels in ``dominance.py``:
    dominators always have strictly smaller sums, every point that survives
    its block-prune is *globally* non-dominated and the buffer never needs
    re-pruning. Control flow lives on the host (bucketed static shapes per
-   XLA's compilation model); all comparisons run on-device.
+   XLA's compilation model); all comparisons run on-device. The streaming
+   engine's production variant of this algorithm is the lazy flush policy
+   (stream/window.py ``sfs_round``: all partitions per launch, non-empty
+   initial state, Pallas kernels); this single-set form remains the library
+   op and the microbench subject (artifacts/kernels_*.json).
 
 This replaces the reference's tuple-at-a-time BNL (FlinkSkyline.java:417-444),
 whose O(|buffer| x |skyline|) pointer-chasing loop is the system's documented

@@ -119,31 +119,6 @@ def skyline_np(x: np.ndarray) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("capacity",))
-def merge_skylines(
-    a: jax.Array,
-    a_valid: jax.Array,
-    b: jax.Array,
-    b_valid: jax.Array,
-    capacity: int,
-):
-    """Union-merge two skyline buffers into one padded buffer of ``capacity``.
-
-    Implements the merge law the two-phase design relies on
-    (skyline(A ∪ B) == skyline(skyline(A) ∪ skyline(B)), SURVEY.md §4):
-    cross-prune each side against the other, then compact survivors to the
-    front. Inputs need not be skylines already — any padded (values, valid)
-    buffers work. Returns (values (capacity, d), valid (capacity,), count).
-
-    This replaces the GlobalSkylineAggregator's incremental BNL merge
-    (FlinkSkyline.java:547-566) with one masked dominance pass.
-    """
-    x = jnp.concatenate([a, b], axis=0)
-    valid = jnp.concatenate([a_valid, b_valid], axis=0)
-    keep = skyline_mask(x, valid)
-    return compact(x, keep, capacity)
-
-
-@functools.partial(jax.jit, static_argnames=("capacity",))
 def compact(x: jax.Array, keep: jax.Array, capacity: int):
     """Pack kept rows to the front of a fixed-size buffer (jit-friendly compaction).
 

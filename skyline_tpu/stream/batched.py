@@ -238,15 +238,17 @@ class PartitionSet:
         for rnd in range(n_rounds):
             with self.tracer.phase("flush/assemble"):
                 batch, bvalid, widths = self._round_batch(rows, rnd, B)
-            out_cap = max(self._cap, _next_pow2(int((self._count_ub + widths).max())))
-            if out_cap > self._cap:
+            def _grow_bucket():
+                return _next_pow2(max(int((self._count_ub + widths).max()), 1))
+
+            grow = _grow_bucket()
+            if grow > self._cap:
                 # about to grow: tighten the bounds with ONE real count sync
                 # (growth events are log-bounded, so steady-state flushes
                 # stay fully async)
                 self._count_ub = np.asarray(self._count_dev, dtype=np.int64)
-                out_cap = max(
-                    self._cap, _next_pow2(int((self._count_ub + widths).max()))
-                )
+                grow = _grow_bucket()
+            out_cap = max(self._cap, grow)
             with self.tracer.phase("flush/device_put"):
                 batch_dev = self._put(batch)
                 bvalid_dev = self._put(bvalid)
@@ -265,15 +267,12 @@ class PartitionSet:
                 else:
                     # active-prefix merge: dominance passes + compact run
                     # over the live-count bucket, not the storage capacity.
-                    # out_active = _next_pow2((count_ub+widths).max()) <=
-                    # out_cap (computed from the same post-sync bounds
-                    # above) and >= active, so no further clamping needed.
+                    # out_active is the SAME bucket out_cap grew from, so
+                    # merge_step_active's max(cap, out_active) == out_cap
+                    # structurally.
                     active = min(
                         self._cap,
                         _next_pow2(max(int(self._count_ub.max()), 1)),
-                    )
-                    out_active = _next_pow2(
-                        max(int((self._count_ub + widths).max()), 1)
                     )
                     self.sky, self.sky_valid, self._count_dev = (
                         merge_step_active(
@@ -282,7 +281,7 @@ class PartitionSet:
                             batch_dev,
                             bvalid_dev,
                             active,
-                            out_active,
+                            grow,
                         )
                     )
                 if self.tracer.sync_device:

@@ -15,7 +15,7 @@ from skyline_tpu.ops import (
     skyline_large,
     skyline_np,
 )
-from skyline_tpu.ops.dominance import compact, merge_skylines
+from skyline_tpu.ops.dominance import compact
 from skyline_tpu.ops.block_skyline import dominated_by_blocked
 
 from conftest import assert_same_set
@@ -118,9 +118,13 @@ def test_merge_law(rng):
     y = rng.uniform(0, 100, size=(150, 3)).astype(np.float32)
     xs = skyline_np(x)
     ys = skyline_np(y)
+    # the union-merge is expressed with the primitives the engine's merge
+    # steps are built from: concat -> skyline_mask -> compact
     a, av = pad_window(xs.astype(np.float32), 256)
     b, bv = pad_window(ys.astype(np.float32), 256)
-    vals, valid, count = merge_skylines(a, av, b, bv, 512)
+    u = jnp.concatenate([a, b], axis=0)
+    uv = jnp.concatenate([av, bv], axis=0)
+    vals, valid, count = compact(u, skyline_mask(u, uv), 512)
     merged = np.asarray(vals)[np.asarray(valid)]
     assert merged.shape[0] == int(count)
     assert_same_set(merged, skyline_np(np.concatenate([x, y], axis=0)))
