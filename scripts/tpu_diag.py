@@ -1,0 +1,106 @@
+"""Tunnel/dispatch diagnostics for the remote-TPU link.
+
+Separates the three costs that can eat a streaming window besides kernel
+time: per-dispatch round trip, host->device and device->host bandwidth, and
+whether a chain of async dispatches actually pipelines (total wall for N
+un-synced rounds followed by one sync vs N x single-round wall).
+
+Usage: python scripts/tpu_diag.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend: {jax.default_backend()}  devices: {jax.devices()}")
+
+    # (a) dispatch+sync round trip of a trivial op
+    x = jnp.ones((8, 128), jnp.float32)
+    f = jax.jit(lambda a: a + 1.0)
+    np.asarray(f(x))
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    print(f"trivial dispatch+sync RTT: p50 {np.median(ts)*1000:.1f} ms")
+
+    # (b) bandwidth
+    for mb in (2, 32):
+        arr = np.ones((mb * 1024 * 1024 // 4,), np.float32)
+        t0 = time.perf_counter()
+        d = jnp.asarray(arr)
+        np.asarray(d[:8])  # force placement
+        up = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(d)
+        down = time.perf_counter() - t0
+        print(f"{mb} MB: up {mb/up:.0f} MB/s  down {mb/down:.0f} MB/s")
+
+    # (c) does a dispatch chain pipeline? 16 chained matmul steps, one sync
+    a = jnp.asarray(np.random.default_rng(0).normal(size=(2048, 2048)).astype(np.float32))
+    g = jax.jit(lambda m: m @ m * 1e-3)
+    np.asarray(g(a)[0, 0])
+    t0 = time.perf_counter()
+    np.asarray(g(a)[0, 0])
+    single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m = a
+    for _ in range(16):
+        m = g(m)
+    np.asarray(m[0, 0])
+    chain = time.perf_counter() - t0
+    print(
+        f"matmul step single {single*1000:.1f} ms; 16-chain wall "
+        f"{chain*1000:.1f} ms ({chain/single:.1f}x single; 16x = no "
+        f"pipelining of dispatch overhead, ~16x kernel-only = healthy)"
+    )
+
+    # (d) the SFS round in a bench-like loop: 8 rounds, no syncs, one sync
+    from skyline_tpu.stream.window import sfs_round
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    P, cap, d, B, active = 8, 65536, 8, 8192, 32768
+    sky = jnp.asarray(np.full((P, cap, d), np.inf, np.float32))
+    counts = jnp.asarray(np.zeros(P, np.int32))
+    blocks = []
+    for _ in range(8):
+        blk = np.stack(
+            [np.sort(anti_correlated(rng, B, d, 0, 10000), axis=0) for _ in range(P)]
+        ).astype(np.float32)
+        blocks.append(blk)
+    bv = jnp.asarray(np.ones((P, B), bool))
+    # warm
+    s2, c2 = sfs_round(sky, counts, jnp.asarray(blocks[0]), bv, active)
+    np.asarray(c2)
+    t0 = time.perf_counter()
+    s, c = sky, counts
+    for blk in blocks:
+        s, c = sfs_round(s, c, jnp.asarray(blk), bv, active)
+    np.asarray(c)
+    loop8 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    s2, c2 = sfs_round(sky, counts, jnp.asarray(blocks[0]), bv, active)
+    np.asarray(c2)
+    single_r = time.perf_counter() - t0
+    print(
+        f"sfs_round: single {single_r*1000:.0f} ms; 8-round loop w/ per-round "
+        f"host device_put, one final sync: {loop8*1000:.0f} ms "
+        f"({loop8/single_r:.1f}x single)"
+    )
+
+
+if __name__ == "__main__":
+    main()
