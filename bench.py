@@ -92,12 +92,17 @@ def child_main(backend: str) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     default_n = 1_000_000
+    default_windows = 3
     if backend == "cpu":
         # reduced fallback so a TPU outage still records a real measurement
-        default_n = int(os.environ.get("BENCH_CPU_N", 131072))
+        # WITHIN the child timeout: the 8-D anti-correlated window is
+        # ~O(N^2) on the CPU scan kernel (measured ~10 min at N=100k), so
+        # size AND window count shrink
+        default_n = int(os.environ.get("BENCH_CPU_N", 32768))
+        default_windows = 1
     n = int(os.environ.get("BENCH_N", default_n))
     d = int(os.environ.get("BENCH_D", 8))
-    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+    windows = int(os.environ.get("BENCH_WINDOWS", default_windows))
     parallelism = int(os.environ.get("BENCH_PARALLELISM", 4))
 
     from skyline_tpu.stream import EngineConfig

@@ -44,6 +44,7 @@ from skyline_tpu.stream.window import (
     meshed_merge_step,
     sfs_cleanup,
     sfs_round,
+    sfs_round_single,
 )
 
 
@@ -198,6 +199,15 @@ class PartitionSet:
         self._pending_rows[:] = 0
         return rows
 
+    def _pad_block(self, part_rows: np.ndarray, B: int):
+        """Pad one partition's (w, d) rows to a (B, d) +inf block +
+        validity mask — the single padding convention both SFS paths and
+        the batched assembly share."""
+        w = part_rows.shape[0]
+        block = np.full((B, self.dims), np.inf, dtype=np.float32)
+        block[:w] = part_rows
+        return block, np.arange(B) < w, w
+
     def _round_batch(self, rows: list[np.ndarray], rnd: int, B: int):
         """Assemble round ``rnd``'s (P, B, d) padded batch + validity +
         per-partition widths from the drained ``rows``."""
@@ -210,9 +220,7 @@ class PartitionSet:
             part_rows = r[rnd * B : (rnd + 1) * B]
             w = part_rows.shape[0]
             if w:
-                batch[p, :w] = part_rows
-                bvalid[p, :w] = True
-                widths[p] = w
+                batch[p], bvalid[p], widths[p] = self._pad_block(part_rows, B)
         return batch, bvalid, widths
 
     def flush_all(self) -> None:
@@ -296,10 +304,113 @@ class PartitionSet:
         self._host_cache = None
         self.processing_ns += time.perf_counter_ns() - t0
 
+    def _sfs_vmapped(self, rows: list[np.ndarray], max_rows: int):
+        """Balanced-load SFS: one vmapped launch per round for all
+        partitions. Returns the device counts vector."""
+        # bigger blocks than the incremental threshold pay off here: the
+        # cross-prune work is block-count invariant, so fewer rounds just
+        # save dispatches (at B^2/2 self-prune cost per round)
+        B = _next_pow2(min(max_rows, max(self.buffer_size, 8192)))
+        n_rounds = -(-max_rows // B)
+        counts = self._count_dev
+        for rnd in range(n_rounds):
+            with self.tracer.phase("flush/assemble"):
+                batch, bvalid, widths = self._round_batch(rows, rnd, B)
+            # the SFS append writes a full B-row block at offset count, so
+            # capacity must cover count + B for every partition
+            need = int(self._count_ub.max()) + B
+            if need > self._cap:
+                self._count_ub = np.asarray(counts, dtype=np.int64)
+                need = int(self._count_ub.max()) + B
+                if need > self._cap:
+                    self._grow_cap(_next_pow2(need))
+            active = min(
+                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+            )
+            with self.tracer.phase("flush/device_put"):
+                batch_dev = jnp.asarray(batch)
+                bvalid_dev = jnp.asarray(bvalid)
+            with self.tracer.phase("flush/merge_kernel"):
+                self.sky, counts = sfs_round(
+                    self.sky, counts, batch_dev, bvalid_dev, active
+                )
+                if self.tracer.sync_device:
+                    np.asarray(counts)
+            self._count_ub = np.minimum(self._cap, self._count_ub + widths)
+        self._count_dev = counts
+        return counts
+
+    def _sfs_sequential(self, rows: list[np.ndarray]):
+        """Skew-path SFS: heavy partitions processed one at a time with
+        per-partition block and active buckets — total work tracks each
+        partition's own rows instead of P x the heaviest. Returns the
+        device counts vector."""
+        # exact starting counts make the per-partition active buckets
+        # tight; sky_counts() is cached, so a had_old flush (which already
+        # synced) pays no extra round trip
+        counts_host = self.sky_counts().astype(np.int64)
+        row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
+        # worst case (nothing pruned) plus one block write of headroom
+        need = int((counts_host + row_counts).max())
+        B_max = _next_pow2(
+            min(max(int(row_counts.max()), 1), max(self.buffer_size, 16384))
+        )
+        if need + B_max > self._cap:
+            self._grow_cap(_next_pow2(need + B_max))
+        new_skies = []
+        new_counts = []
+        for p in range(self.num_partitions):
+            rp = rows[p]
+            sky_p = self.sky[p]
+            cnt_p = self._count_dev[p]
+            ub_p = int(counts_host[p])
+            if rp.shape[0]:
+                B = _next_pow2(
+                    min(rp.shape[0], max(self.buffer_size, 16384))
+                )
+                for rnd in range(-(-rp.shape[0] // B)):
+                    with self.tracer.phase("flush/assemble"):
+                        block, bvalid, w = self._pad_block(
+                            rp[rnd * B : (rnd + 1) * B], B
+                        )
+                    active = min(
+                        self._cap, _next_pow2(max(ub_p, 1))
+                    )
+                    with self.tracer.phase("flush/device_put"):
+                        block_dev = jnp.asarray(block)
+                        bvalid_dev = jnp.asarray(bvalid)
+                    with self.tracer.phase("flush/merge_kernel"):
+                        sky_p, cnt_p = sfs_round_single(
+                            sky_p, cnt_p, block_dev, bvalid_dev, active
+                        )
+                        if self.tracer.sync_device:
+                            np.asarray(cnt_p)
+                    ub_p = min(self._cap, ub_p + w)
+            new_skies.append(sky_p)
+            new_counts.append(cnt_p)
+            self._count_ub[p] = ub_p
+        # one stacked reassembly (device-side; no host transfer)
+        self.sky = jnp.stack(new_skies)
+        counts = jnp.stack(new_counts).astype(jnp.int32)
+        self._count_dev = counts
+        return counts
+
+    def _grow_cap(self, new_cap: int) -> None:
+        """Grow the stacked skyline storage to ``new_cap`` rows (padding
+        with +inf, which both flush policies treat as invalid)."""
+        pad = jnp.full(
+            (self.num_partitions, new_cap - self._cap, self.dims),
+            jnp.inf,
+            dtype=jnp.float32,
+        )
+        self.sky = self._put(jnp.concatenate([self.sky, pad], axis=1))
+        self._cap = new_cap
+
     def _flush_lazy(self) -> None:
         """Lazy-policy flush: sum-sort each partition's accumulated window
-        and stream it through append-only SFS rounds (one vmapped launch per
-        round). See stream/window.py's SFS notes for the invariant."""
+        and stream it through append-only SFS rounds — one vmapped launch
+        per round for balanced loads, per-partition rounds under routing
+        skew. See stream/window.py's SFS notes for the invariant."""
         t0 = time.perf_counter_ns()
         with self.tracer.phase("flush/assemble"):
             rows = self._drain_pending()
@@ -317,43 +428,17 @@ class PartitionSet:
             had_old = False
 
         max_rows = max(r.shape[0] for r in rows)
-        # bigger blocks than the incremental threshold pay off here: the
-        # cross-prune work is block-count invariant, so fewer rounds just
-        # save dispatches (at B^2/2 self-prune cost per round)
-        B = _next_pow2(min(max_rows, max(self.buffer_size, 8192)))
-        n_rounds = -(-max_rows // B)
-        counts = self._count_dev
-        for rnd in range(n_rounds):
-            with self.tracer.phase("flush/assemble"):
-                batch, bvalid, widths = self._round_batch(rows, rnd, B)
-            # the SFS append writes a full B-row block at offset count, so
-            # capacity must cover count + B for every partition
-            need = int(self._count_ub.max()) + B
-            if need > self._cap:
-                self._count_ub = np.asarray(counts, dtype=np.int64)
-                need = int(self._count_ub.max()) + B
-                if need > self._cap:
-                    new_cap = _next_pow2(need)
-                    pad = jnp.full(
-                        (self.num_partitions, new_cap - self._cap, self.dims),
-                        jnp.inf,
-                        dtype=jnp.float32,
-                    )
-                    self.sky = jnp.concatenate([self.sky, pad], axis=1)
-                    self._cap = new_cap
-            active = min(
-                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
-            )
-            with self.tracer.phase("flush/device_put"):
-                batch_dev = jnp.asarray(batch)
-                bvalid_dev = jnp.asarray(bvalid)
-            with self.tracer.phase("flush/merge_kernel"):
-                self.sky, counts = sfs_round(
-                    self.sky, counts, batch_dev, bvalid_dev, active
-                )
-                if self.tracer.sync_device:
-                    np.asarray(counts)
-            self._count_ub = np.minimum(self._cap, self._count_ub + widths)
+        total_rows = int(sum(r.shape[0] for r in rows))
+        # path choice: the vmapped round costs P lanes of (B x active) work
+        # per round regardless of how many lanes carry real rows, i.e.
+        # ~P * max_rows lane-rows total; the per-partition sequential path
+        # costs ~total_rows. Under routing skew (mr-angle at 8D sends ~96%
+        # of rows to 2 of 8 partitions) sequential wins by ~P/2; balanced
+        # streams keep the one-launch-per-round batching.
+        if self.num_partitions * max_rows > 2 * total_rows:
+            counts = self._sfs_sequential(rows)
+        else:
+            counts = self._sfs_vmapped(rows, max_rows)
         if had_old:
             old_active = min(
                 self._cap, _next_pow2(max(int(old_counts.max()), 1))
@@ -373,6 +458,11 @@ class PartitionSet:
         self.sky_valid = jnp.arange(self._cap)[None, :] < counts[:, None]
         self._counts_cache = None
         self._host_cache = None
+        # tighten the upper bounds with ONE sync: the caller's next step is
+        # almost always the global merge, whose active bucket comes from
+        # _count_ub — loose row-count bounds (vs true survivor counts) can
+        # double its pairwise work for nothing
+        self.sky_counts()
         self.processing_ns += time.perf_counter_ns() - t0
 
     # -- query ------------------------------------------------------------
@@ -386,14 +476,16 @@ class PartitionSet:
         full-buffer snapshot pull + host merge + re-upload. Single-device
         only (the engine falls back to the host path under a mesh).
         """
-        # the count upper bounds are maintained without syncs, so this
-        # active bucket costs no round trip (pessimistic is safe: rows
-        # between count and active are invalid by the mask)
+        # the count upper bounds are maintained without syncs, so these
+        # buckets cost no round trip (pessimistic is safe: rows between
+        # count and active are invalid by the mask; union_cap from the
+        # SUMMED bounds keeps the pass union-sized under routing skew)
         active = min(
             self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
         )
-        keep, stats = global_merge_stats_device(
-            self.sky, self._count_dev, active
+        union_cap = _next_pow2(max(int(self._count_ub.sum()), 1))
+        union, keep, stats = global_merge_stats_device(
+            self.sky, self._count_dev, active, union_cap
         )
         with self.tracer.phase("query/global_stats_sync"):
             svec = np.asarray(stats, dtype=np.int64)
@@ -404,7 +496,7 @@ class PartitionSet:
             out_cap = _next_pow2(max(g, 1))
             with self.tracer.phase("query/points_transfer"):
                 pts = np.asarray(
-                    global_points_device(self.sky, keep, active, out_cap)
+                    global_points_device(union, keep, out_cap)
                 )[:g].copy()
         self._counts_cache = counts.copy()
         self._count_ub = counts.copy()

@@ -210,7 +210,10 @@ def _sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
 def sfs_round(sky, counts, blocks, bvalids, active: int):
     """Vmapped SFS round over all partitions: sky (P, cap, d), counts (P,)
     int32, blocks (P, B, d), bvalids (P, B) -> (sky', counts'). One device
-    launch for the whole set."""
+    launch for the whole set — right when partitions carry comparable row
+    counts (every vmap lane computes the full (B x active) passes whether
+    its block is real or padding; see ``sfs_round_single`` for the skewed
+    case)."""
     from skyline_tpu.ops.dispatch import on_tpu
 
     use_pallas = on_tpu()
@@ -220,6 +223,21 @@ def sfs_round(sky, counts, blocks, bvalids, active: int):
         return _sfs_round_core(s, c, b, bv, active, use_pallas, interp)
 
     return jax.vmap(core)(sky, counts, blocks, bvalids)
+
+
+@functools.partial(jax.jit, static_argnames=("active",))
+def sfs_round_single(sky_p, count, block, bvalid, active: int):
+    """One partition's SFS round without the vmap lane dimension: sky_p
+    (cap, d), count () int32, block (B, d), bvalid (B,). Under routing skew
+    (one or two partitions holding most of the stream — mr-angle at 8D
+    anti-correlated routes ~96%% of rows to 2 of 8 partitions) the vmapped
+    round pays P lanes of (B x active) work for one real lane; processing
+    the heavy partitions individually costs exactly their own rows."""
+    from skyline_tpu.ops.dispatch import on_tpu
+
+    return _sfs_round_core(
+        sky_p, count, block, bvalid, active, on_tpu(), _pallas_interpret()
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("old_active", "active"))
@@ -260,39 +278,65 @@ def sfs_cleanup(sky, counts, old_counts, old_active: int, active: int):
     return vals, cnt.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("active",))
-def global_merge_stats_device(sky, counts, active: int):
-    """Device-side two-phase finish over the stacked state: one triangular
-    pass over the flattened (P*active) union instead of pulling every
-    partition's buffer to host, merging there, and re-uploading
-    (GlobalSkylineAggregator's role, FlinkSkyline.java:547-608, minus the
-    host round-trip). ``active`` (static) is the capacity bucket of the
-    current max count — the pass never pays for capacity padding beyond it
-    (measured 1.36 s full-cap vs ~0.4 s active-sliced at counts ~20k,
-    cap 64k). Returns (keep (P*active,) bool — still on device for the
-    optional points path — and a packed stats vector
-    [counts (P,), survivors_per_partition (P,), global_count] so the caller
-    syncs ONE small transfer)."""
+@functools.partial(jax.jit, static_argnames=("active", "union_cap"))
+def global_merge_stats_device(sky, counts, active: int, union_cap: int):
+    """Device-side two-phase finish over the stacked state: gather every
+    partition's live prefix into ONE contiguous union buffer, then a single
+    triangular pass — instead of pulling buffers to host, merging there,
+    and re-uploading (GlobalSkylineAggregator's role,
+    FlinkSkyline.java:547-608, minus the host round-trip).
+
+    ``active`` (static) bounds each partition's copied prefix (the bucket
+    of the max count); ``union_cap`` (static) is the bucket of the summed
+    counts — the dominance pass runs over the union's size, NOT P x active.
+    Under routing skew (mr-angle at 8D sends ~96%% of rows to 2 of 8
+    partitions) the flattened-padded formulation pays (P*active)^2 while
+    the union is barely bigger than one partition — a 16x difference at the
+    north-star window.
+
+    The sequential gather writes each partition's full ``active`` slice at
+    the running count offset: rows >= count are +inf padding under BOTH
+    flush policies (compact/SFS-append invariants), each write's garbage
+    tail is overwritten by the next partition's rows, and the buffer keeps
+    an ``active``-row scratch tail so no write ever clamps.
+
+    Returns (union (union_cap, d) — still on device for the points path —
+    keep (union_cap,) bool, and a packed stats vector [counts (P,),
+    survivors_per_partition (P,), global_count] so the caller syncs ONE
+    small transfer)."""
     from skyline_tpu.ops.dispatch import skyline_mask_auto
 
     P, cap, d = sky.shape
-    flat = lax.slice(sky, (0, 0, 0), (P, active, d)).reshape(P * active, d)
-    valid = (jnp.arange(active)[None, :] < counts[:, None]).reshape(P * active)
-    keep = skyline_mask_auto(flat, valid)
-    surv = keep.reshape(P, active).sum(axis=1, dtype=jnp.int32)
+    scratch = union_cap + active
+    u = jnp.full((scratch, d), jnp.inf, dtype=sky.dtype)
+    uo = jnp.zeros((scratch,), dtype=jnp.int32)
+    off = jnp.zeros((), jnp.int32)
+    for p in range(P):  # static unroll; P is small
+        sl = lax.slice(sky, (p, 0, 0), (p + 1, active, d)).reshape(active, d)
+        u = lax.dynamic_update_slice(u, sl, (off, jnp.zeros((), jnp.int32)))
+        uo = lax.dynamic_update_slice(
+            uo, jnp.full((active,), p, jnp.int32), (off,)
+        )
+        off = off + counts[p].astype(jnp.int32)
+    u = lax.slice(u, (0, 0), (union_cap, d))
+    uo = lax.slice(uo, (0,), (union_cap,))
+    uv = jnp.arange(union_cap) < off
+    keep = skyline_mask_auto(u, uv)
+    surv = jax.ops.segment_sum(
+        keep.astype(jnp.int32), uo, num_segments=P
+    )
     g = keep.sum(dtype=jnp.int32)
     stats = jnp.concatenate([counts.astype(jnp.int32), surv, g[None]])
-    return keep, stats
+    return u, keep, stats
 
 
-@functools.partial(jax.jit, static_argnames=("active", "out_cap"))
-def global_points_device(sky, keep, active: int, out_cap: int):
-    """Compact the global survivors (from ``global_merge_stats_device``,
-    same ``active``) to the front of an (out_cap, d) buffer for a single
-    bounded transfer — only paid when a query asks for skyline_points."""
-    P, cap, d = sky.shape
-    flat = lax.slice(sky, (0, 0, 0), (P, active, d)).reshape(P * active, d)
-    return compact(flat, keep, out_cap)[0]
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def global_points_device(union, keep, out_cap: int):
+    """Compact the global survivors (union + keep from
+    ``global_merge_stats_device``) to the front of an (out_cap, d) buffer
+    for a single bounded transfer — only paid when a query asks for
+    skyline_points."""
+    return compact(union, keep, out_cap)[0]
 
 
 @functools.lru_cache(maxsize=None)

@@ -135,6 +135,34 @@ def test_lazy_policy_matches_incremental_and_oracle(rng, algo):
     )
 
 
+def test_lazy_policy_under_extreme_skew(rng):
+    # mr-dim with clustered dim0 routes nearly everything to one partition:
+    # exercises the sequential (per-partition) SFS path and the
+    # union-compacted global merge; results must still match the oracle
+    x = np.column_stack([
+        rng.uniform(0, 50, size=6000),  # all in the lowest dim0 range
+        rng.uniform(0, 1000, size=6000),
+        rng.uniform(0, 1000, size=6000),
+    ]).astype(np.float32)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, algo="mr-dim", dims=3, domain_max=1000.0,
+                     flush_policy="lazy", emit_skyline_points=True)
+    )
+    for i in range(0, 6000, 1000):
+        _feed(eng, x[i : i + 1000], start_id=i)
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    oracle = skyline_np(x)
+    assert r["skyline_size"] == oracle.shape[0]
+    assert_same_set(np.asarray(r["skyline_points"]), oracle)
+    # second query re-runs the skew path on non-empty state
+    y = rng.uniform(0, 1000, size=(3000, 3)).astype(np.float32)
+    _feed(eng, y, start_id=6000)
+    eng.process_trigger("1,0")
+    (r2,) = eng.poll_results()
+    assert r2["skyline_size"] == skyline_np(np.concatenate([x, y])).shape[0]
+
+
 def test_lazy_policy_sequential_queries(rng):
     # second query under lazy hits the non-empty-initial-state path (SFS
     # append + old-vs-new cleanup); dominated old skyline rows must vanish
