@@ -350,11 +350,22 @@ class PartitionSet:
         # synced) pays no extra round trip
         counts_host = self.sky_counts().astype(np.int64)
         row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
+
+        def _seq_block(rows_p: int) -> int:
+            # scale the block with the partition: a ~500k-row heavy
+            # partition runs 8 rounds at B=64k instead of 30 at 16k (the
+            # self-prune cost grows only linearly in B, dispatch latency
+            # through the tunnel per round is the real price)
+            return _next_pow2(
+                min(
+                    max(rows_p, 1),
+                    max(self.buffer_size, 16384, min(rows_p // 8, 65536)),
+                )
+            )
+
         # worst case (nothing pruned) plus one block write of headroom
         need = int((counts_host + row_counts).max())
-        B_max = _next_pow2(
-            min(max(int(row_counts.max()), 1), max(self.buffer_size, 16384))
-        )
+        B_max = _seq_block(int(row_counts.max()))
         if need + B_max > self._cap:
             self._grow_cap(_next_pow2(need + B_max))
         new_skies = []
@@ -365,9 +376,7 @@ class PartitionSet:
             cnt_p = self._count_dev[p]
             ub_p = int(counts_host[p])
             if rp.shape[0]:
-                B = _next_pow2(
-                    min(rp.shape[0], max(self.buffer_size, 16384))
-                )
+                B = _seq_block(rp.shape[0])
                 for rnd in range(-(-rp.shape[0] // B)):
                     with self.tracer.phase("flush/assemble"):
                         block, bvalid, w = self._pad_block(
