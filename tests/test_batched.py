@@ -143,3 +143,106 @@ def test_meshed_merge_pallas_interpret(rng, monkeypatch):
     counts = np.asarray(out_count)
     for p, x in enumerate(parts):
         assert_same_set(out_sky[p, :counts[p]], oracle(x))
+
+
+def test_lazy_flush_path_choice(rng, monkeypatch):
+    """The lazy flush picks per-partition sequential SFS under routing skew
+    (P * max_rows > 2 * total_rows) and the one-launch-per-round vmapped SFS
+    for balanced loads — and both produce the oracle skyline either way."""
+    calls = []
+    orig_seq = PartitionSet._sfs_sequential
+    orig_vm = PartitionSet._sfs_vmapped
+    monkeypatch.setattr(
+        PartitionSet, "_sfs_sequential",
+        lambda self, rows: calls.append("seq") or orig_seq(self, rows))
+    monkeypatch.setattr(
+        PartitionSet, "_sfs_vmapped",
+        lambda self, rows, m: calls.append("vmap") or orig_vm(self, rows, m))
+
+    # skewed: one of 4 partitions holds ~all rows
+    ps = PartitionSet(num_partitions=4, dims=3, flush_policy="lazy")
+    heavy = rng.uniform(0, 100, size=(4000, 3)).astype(np.float32)
+    light = rng.uniform(0, 100, size=(5, 3)).astype(np.float32)
+    ps.add_batch(0, heavy, max_id=0, now_ms=0.0)
+    ps.add_batch(1, light, max_id=1, now_ms=0.0)
+    ps.flush_all()
+    assert calls == ["seq"]
+    assert_same_set(ps.snapshot(0), skyline_np(heavy))
+    assert_same_set(ps.snapshot(1), skyline_np(light))
+
+    # balanced: every partition carries the same load
+    calls.clear()
+    ps2 = PartitionSet(num_partitions=4, dims=3, flush_policy="lazy")
+    parts = [rng.uniform(0, 100, size=(1000, 3)).astype(np.float32)
+             for _ in range(4)]
+    for p, x in enumerate(parts):
+        ps2.add_batch(p, x, max_id=p, now_ms=0.0)
+    ps2.flush_all()
+    assert calls == ["vmap"]
+    for p, x in enumerate(parts):
+        assert_same_set(ps2.snapshot(p), skyline_np(x))
+
+
+def test_sfs_round_single_matches_vmapped(rng):
+    """sfs_round_single (skew path) is lane-for-lane identical to the
+    vmapped sfs_round on the same sum-sorted blocks."""
+    import jax.numpy as jnp
+
+    from skyline_tpu.stream.window import _MIN_CAP, sfs_round, sfs_round_single
+
+    P, B, d, cap = 3, 256, 4, _MIN_CAP
+    sky0 = np.full((P, cap, d), np.inf, dtype=np.float32)
+    counts0 = np.zeros((P,), dtype=np.int32)
+    parts = [rng.uniform(0, 100, size=(2 * B, d)).astype(np.float32)
+             for _ in range(P)]
+    parts = [x[np.argsort(x.sum(axis=1), kind="stable")] for x in parts]
+
+    sky_v = jnp.asarray(sky0)
+    cnt_v = jnp.asarray(counts0)
+    singles = [(jnp.asarray(sky0[p]), jnp.asarray(counts0[p]))
+               for p in range(P)]
+    for rnd in range(2):
+        batch = np.stack([x[rnd * B:(rnd + 1) * B] for x in parts])
+        bvalid = np.ones((P, B), dtype=bool)
+        sky_v, cnt_v = sfs_round(
+            sky_v, cnt_v, jnp.asarray(batch), jnp.asarray(bvalid), cap)
+        singles = [
+            sfs_round_single(s, c, jnp.asarray(batch[p]),
+                             jnp.asarray(bvalid[p]), cap)
+            for p, (s, c) in enumerate(singles)]
+    cnt_v = np.asarray(cnt_v)
+    for p, (s, c) in enumerate(singles):
+        assert int(c) == int(cnt_v[p])
+        assert_same_set(np.asarray(s)[:int(c)],
+                        np.asarray(sky_v)[p, :int(c)])
+        # SFS invariant: the appended prefix IS the partition's skyline
+        assert_same_set(np.asarray(s)[:int(c)], skyline_np(parts[p]))
+
+
+def test_global_merge_stats_matches_host_oracle(rng):
+    """Device-side union merge (one small stats transfer) returns the same
+    per-partition counts, survivor counts, global size, and points as
+    merging the pulled snapshots on host — including under skew."""
+    ps = PartitionSet(num_partitions=4, dims=3, flush_policy="lazy")
+    sizes = (3000, 40, 0, 800)
+    parts = [rng.uniform(0, 100, size=(n, 3)).astype(np.float32)
+             for n in sizes]
+    for p, x in enumerate(parts):
+        if x.shape[0]:
+            ps.add_batch(p, x, max_id=p, now_ms=0.0)
+    ps.flush_all()
+    counts, surv, g, pts = ps.global_merge_stats(emit_points=True)
+
+    locals_ = [skyline_np(x) if x.shape[0] else np.empty((0, 3))
+               for x in parts]
+    union = np.concatenate(locals_, axis=0)
+    glob = skyline_np(union)
+    assert list(counts) == [s.shape[0] for s in locals_]
+    assert g == glob.shape[0]
+    assert_same_set(pts, glob)
+    # survivors per partition sum to the global count
+    assert int(surv.sum()) == g
+    for p, loc in enumerate(locals_):
+        keep = np.array([any(np.array_equal(r, gr) for gr in glob)
+                         for r in loc]) if loc.shape[0] else np.empty(0)
+        assert surv[p] == int(keep.sum()) if loc.shape[0] else surv[p] == 0
