@@ -85,11 +85,9 @@ def child_main(backend: str) -> None:
 
     # persistent XLA compilation cache: the capacity-bucket executables
     # survive across bench runs, collapsing the warmup window
-    cache_dir = os.environ.get(
-        "BENCH_COMPILE_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
-    )
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from skyline_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
 
     default_n = 1_000_000
     default_windows = 3

@@ -1,0 +1,28 @@
+"""Shared measurement helpers for the benchmark runners.
+
+One implementation of the window-drive loop so every runner (BASELINE
+matrix, reference grid) measures identically: fresh engine, 65536-record
+ingest chunks, immediate trigger, end-to-end wall including routing and
+result assembly — the TotalTime semantics of FlinkSkyline.java:587.
+"""
+
+from __future__ import annotations
+
+import time
+
+CHUNK = 65536
+
+
+def one_window(cfg, ids, x):
+    """One tumbling window end-to-end through a fresh engine; returns
+    (wall_s, result)."""
+    from skyline_tpu.stream import SkylineEngine
+
+    eng = SkylineEngine(cfg)
+    n = x.shape[0]
+    t0 = time.perf_counter()
+    for i in range(0, n, CHUNK):
+        eng.process_records(ids[i : i + CHUNK], x[i : i + CHUNK])
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    return time.perf_counter() - t0, r

@@ -23,6 +23,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmarks._common import one_window
 from skyline_tpu.metrics.collector import append_result_row
 from skyline_tpu.stream import EngineConfig, SkylineEngine
 from skyline_tpu.stream.sliding_engine import SlidingEngine
@@ -39,19 +40,20 @@ CONFIGS = [
 SLIDING_CONFIG = ("sliding_4d_anticorrelated", "anti_correlated", 4, 200_000, 50_000)
 
 
-def run_tumbling(name, dist, dims, algo, n, outdir, policy="lazy"):
+def run_tumbling(name, dist, dims, algo, n, outdir, policy="lazy",
+                 warmup=True):
     rng = np.random.default_rng(0)
     cfg = EngineConfig(parallelism=4, algo=algo, dims=dims, domain_max=10000.0,
                        buffer_size=8192, flush_policy=policy)
-    eng = SkylineEngine(cfg)
     x = generate(dist, rng, n, dims, 0, 10000)
     ids = np.arange(n, dtype=np.int64)
-    t0 = time.perf_counter()
-    for i in range(0, n, 65536):
-        eng.process_records(ids[i : i + 65536], x[i : i + 65536])
-    eng.process_trigger("0,0")
-    (r,) = eng.poll_results()
-    dt = time.perf_counter() - t0
+    # warmup window (same data -> identical shape-bucket sequence): measured
+    # windows then reflect steady-state streaming, not XLA compile latency —
+    # the same methodology as bench.py's warmup window
+    warm_s = 0.0
+    if warmup:
+        warm_s, _ = one_window(cfg, ids, x)
+    dt, r = one_window(cfg, ids, x)
     append_result_row(os.path.join(outdir, f"{name}.csv"),
                       {**r, "record_count": n})
     return {
@@ -61,29 +63,39 @@ def run_tumbling(name, dist, dims, algo, n, outdir, policy="lazy"):
         "algo": algo,
         "tuples_per_sec": round(n / dt, 1),
         "window_s": round(dt, 2),
+        "warmup_window_s": round(warm_s, 2),
         "skyline_size": r["skyline_size"],
         "optimality": r["optimality"],
     }
 
 
-def run_sliding(name, dist, dims, window, slide, outdir):
-    """Sliding config through the first-class SlidingEngine (worker-grade
-    path: routing, bucket rings, per-slide results, collector CSV)."""
-    rng = np.random.default_rng(0)
-    eng = SlidingEngine(
-        EngineConfig(parallelism=4, algo="mr-angle", dims=dims,
-                     domain_max=10000.0),
-        window_size=window, slide=slide, emit_per_slide=True,
-    )
-    n = window * 4  # several full-overlap slides
-    x = generate(dist, rng, n, dims, 0, 10000)
-    ids = np.arange(n, dtype=np.int64)
+def _one_sliding_run(cfg, window, slide, ids, x):
+    """One full sliding stream through a fresh SlidingEngine; returns
+    (wall_s, per-slide results)."""
+    eng = SlidingEngine(cfg, window_size=window, slide=slide,
+                        emit_per_slide=True)
+    n = x.shape[0]
     t0 = time.perf_counter()
     results = []
     for i in range(0, n, 65536):
         eng.process_records(ids[i : i + 65536], x[i : i + 65536])
         results.extend(eng.poll_results())
-    dt = time.perf_counter() - t0
+    return time.perf_counter() - t0, results
+
+
+def run_sliding(name, dist, dims, window, slide, outdir, warmup=True):
+    """Sliding config through the first-class SlidingEngine (worker-grade
+    path: routing, bucket rings, per-slide results, collector CSV)."""
+    rng = np.random.default_rng(0)
+    cfg = EngineConfig(parallelism=4, algo="mr-angle", dims=dims,
+                      domain_max=10000.0)
+    n = window * 4  # several full-overlap slides
+    x = generate(dist, rng, n, dims, 0, 10000)
+    ids = np.arange(n, dtype=np.int64)
+    warm_s = 0.0
+    if warmup:
+        warm_s, _ = _one_sliding_run(cfg, window, slide, ids, x)
+    dt, results = _one_sliding_run(cfg, window, slide, ids, x)
     for r in results:
         append_result_row(os.path.join(outdir, f"{name}.csv"), r)
     sizes = [r["skyline_size"] for r in results if r["window_filled"]]
@@ -94,6 +106,8 @@ def run_sliding(name, dist, dims, window, slide, outdir):
         "window": window,
         "slide": slide,
         "tuples_per_sec": round(n / dt, 1),
+        "stream_s": round(dt, 2),
+        "warmup_stream_s": round(warm_s, 2),
         "slides": len(results),
         "skyline_size_median": int(np.median(sizes)) if sizes else 0,
     }
@@ -107,6 +121,9 @@ def main(argv=None):
     ap.add_argument("--policy", choices=("incremental", "lazy"),
                     default="lazy",
                     help="tumbling-config flush policy (lazy = SFS at query)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the unmeasured warmup pass per config "
+                         "(measured numbers then include XLA compiles)")
     a = ap.parse_args(argv)
     import jax
 
@@ -115,18 +132,15 @@ def main(argv=None):
     # the tunnel is down); the config update actually pins the backend
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from skyline_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     os.makedirs(a.outdir, exist_ok=True)
     for name, dist, dims, algo, n in CONFIGS:
         if a.only and a.only not in name:
             continue
         out = run_tumbling(name, dist, dims, algo, max(10_000, int(n * a.scale)),
-                           a.outdir, policy=a.policy)
+                           a.outdir, policy=a.policy, warmup=not a.no_warmup)
         print(json.dumps(out))
     name, dist, dims, window, slide = SLIDING_CONFIG
     if not a.only or a.only in name:
@@ -134,7 +148,8 @@ def main(argv=None):
         # (SlidingSkyline requires window_size % slide == 0 at any --scale)
         k = window // slide
         s = max(2_500, int(slide * a.scale))
-        out = run_sliding(name, dist, dims, k * s, s, a.outdir)
+        out = run_sliding(name, dist, dims, k * s, s, a.outdir,
+                          warmup=not a.no_warmup)
         print(json.dumps(out))
     return 0
 

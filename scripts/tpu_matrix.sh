@@ -23,14 +23,16 @@ timeout 3600 python bench.py > artifacts/bench_tpu.json \
   || echo "BENCH FAILED rc=$?"
 tail -c 600 artifacts/bench_tpu.json; echo
 
+# timeouts sized for the default warmup pass (each config runs twice:
+# one unmeasured warmup window + one measured window)
 echo "--- [3/5] BASELINE matrix (scale 1)"
-timeout 10800 python benchmarks/run_configs.py --scale 1 --outdir bench_out_tpu \
+timeout 14400 python benchmarks/run_configs.py --scale 1 --outdir bench_out_tpu \
   > artifacts/baseline_matrix.jsonl \
   || echo "RUN_CONFIGS FAILED rc=$?"
 cat artifacts/baseline_matrix.jsonl
 
 echo "--- [4/5] reference grid + overlay figures"
-timeout 7200 python benchmarks/reference_grid.py --n 1000000 \
+timeout 10800 python benchmarks/reference_grid.py --n 1000000 \
   --outdir bench_out_tpu --figdir artifacts \
   || echo "GRID FAILED rc=$?"
 

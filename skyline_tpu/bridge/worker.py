@@ -125,9 +125,13 @@ def main(argv=None):
     import sys
 
     from skyline_tpu.bridge.kafka import KafkaBus
+    from skyline_tpu.utils.compile_cache import enable_compile_cache
     from skyline_tpu.utils.config import parse_job_args
 
     cfg = parse_job_args(argv)
+    # restarted workers reuse every previously compiled executable
+    # (SKYLINE_COMPILE_CACHE overrides the location)
+    enable_compile_cache()
     bus = KafkaBus(cfg.bootstrap)
     worker = SkylineWorker(
         bus,
