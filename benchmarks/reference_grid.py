@@ -18,7 +18,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -26,7 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks._common import one_window
 from skyline_tpu.metrics.collector import append_result_row
-from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.stream import EngineConfig
 from skyline_tpu.workload.generators import anti_correlated
 
 ALGOS = ["mr-dim", "mr-grid", "mr-angle"]

@@ -23,9 +23,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmarks._common import one_window
+from benchmarks._common import CHUNK, one_window
 from skyline_tpu.metrics.collector import append_result_row
-from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.stream import EngineConfig
 from skyline_tpu.stream.sliding_engine import SlidingEngine
 from skyline_tpu.workload.generators import generate
 
@@ -77,8 +77,8 @@ def _one_sliding_run(cfg, window, slide, ids, x):
     n = x.shape[0]
     t0 = time.perf_counter()
     results = []
-    for i in range(0, n, 65536):
-        eng.process_records(ids[i : i + 65536], x[i : i + 65536])
+    for i in range(0, n, CHUNK):
+        eng.process_records(ids[i : i + CHUNK], x[i : i + CHUNK])
         results.extend(eng.poll_results())
     return time.perf_counter() - t0, results
 

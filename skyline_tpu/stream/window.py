@@ -18,13 +18,18 @@ executables.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from skyline_tpu.ops.dominance import compact, dominated_by, skyline_mask
+from skyline_tpu.ops.sfs import (  # noqa: F401  (re-exported: the SFS
+    pallas_interpret as _pallas_interpret,  # kernels moved to the ops layer)
+    sfs_cleanup,
+    sfs_round,
+    sfs_round_single,
+)
 from skyline_tpu.utils.buckets import next_pow2
 
 # Reference flushes its input buffer at 5000 tuples (BUFFER_SIZE,
@@ -63,15 +68,6 @@ def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     x = jnp.concatenate([sky, batch], axis=0)
     keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
     return compact(x, keep, out_cap)
-
-
-def _pallas_interpret() -> bool:
-    """Read lazily (at trace time, not import time): set
-    ``SKYLINE_PALLAS_INTERPRET=1`` to run the Pallas merge in interpret mode
-    on CPU — how ``dryrun_multichip`` validates the shard_map-of-pallas_call
-    lowering without TPU hardware. Evaluated when a merge step first traces;
-    already-compiled executables are unaffected by later env changes."""
-    return os.environ.get("SKYLINE_PALLAS_INTERPRET", "") == "1"
 
 
 def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
@@ -151,131 +147,6 @@ def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: in
             [valid, jnp.zeros((P, out_cap - out_active), dtype=bool)], axis=1
         )
     return vals, valid, cnt.astype(jnp.int32)
-
-
-# --------------------------------------------------------------------------
-# SFS (sort-filter-skyline) rounds: the lazy flush policy's kernel.
-#
-# For a tumbling window queried once, incremental maintenance is wasted
-# work: every flush re-prunes the running skyline against the new batch
-# both ways and re-compacts the full buffer. When ALL rows are available at
-# trigger time, sum-sorting each partition's window and streaming blocks in
-# ascending-sum order makes the skyline buffer APPEND-ONLY (a dominator
-# always has a strictly smaller coordinate sum, so nothing already appended
-# can be dominated by a later block): one forward pass, one small compact
-# per block, no buffer re-pruning. This is `ops.block_skyline.skyline_large`
-# generalized to all partitions at once (one vmapped launch per round) and
-# to non-empty initial state.
-# --------------------------------------------------------------------------
-
-
-def _sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
-    """One SFS append round for one partition.
-
-    sky: (cap, d) buffer whose first ``count`` rows are a skyline; block:
-    (B, d) sum-sorted ascending (invalid rows padded +inf at the end), with
-    all sums >= any previously appended block's in this SFS pass. Appends
-    the block's survivors at ``count``. ``active`` (static) bounds the
-    dominator prefix actually compared against — the capacity bucket of the
-    current max count, so early rounds don't pay full-capacity passes.
-
-    Caller guarantees count + B <= cap (the compacted block writes B slots;
-    rows past the survivor count are +inf padding landing on virgin rows).
-    """
-    cap, d = sky.shape
-    sky_act = lax.slice(sky, (0, 0), (active, d))
-    sky_ok = jnp.arange(active) < count
-    if use_pallas:
-        from skyline_tpu.ops.pallas_dominance import (
-            dominated_by_any_pallas,
-            dominated_by_pallas,
-        )
-
-        block_t = block.T
-        keep = bvalid & ~dominated_by_any_pallas(
-            block_t, bvalid, triangular=True, interpret=interp
-        )
-        keep = keep & ~dominated_by_pallas(
-            sky_act.T, sky_ok, block_t, interpret=interp
-        )
-    else:
-        keep = skyline_mask(block, bvalid)
-        keep = keep & ~dominated_by(block, sky_act, x_valid=sky_ok)
-    vals, _, m = compact(block, keep, block.shape[0])
-    sky = lax.dynamic_update_slice(sky, vals, (count, 0))
-    return sky, count + m
-
-
-@functools.partial(jax.jit, static_argnames=("active",))
-def sfs_round(sky, counts, blocks, bvalids, active: int):
-    """Vmapped SFS round over all partitions: sky (P, cap, d), counts (P,)
-    int32, blocks (P, B, d), bvalids (P, B) -> (sky', counts'). One device
-    launch for the whole set — right when partitions carry comparable row
-    counts (every vmap lane computes the full (B x active) passes whether
-    its block is real or padding; see ``sfs_round_single`` for the skewed
-    case)."""
-    from skyline_tpu.ops.dispatch import on_tpu
-
-    use_pallas = on_tpu()
-    interp = _pallas_interpret()
-
-    def core(s, c, b, bv):
-        return _sfs_round_core(s, c, b, bv, active, use_pallas, interp)
-
-    return jax.vmap(core)(sky, counts, blocks, bvalids)
-
-
-@functools.partial(jax.jit, static_argnames=("active",))
-def sfs_round_single(sky_p, count, block, bvalid, active: int):
-    """One partition's SFS round without the vmap lane dimension: sky_p
-    (cap, d), count () int32, block (B, d), bvalid (B,). Under routing skew
-    (one or two partitions holding most of the stream — mr-angle at 8D
-    anti-correlated routes ~96%% of rows to 2 of 8 partitions) the vmapped
-    round pays P lanes of (B x active) work for one real lane; processing
-    the heavy partitions individually costs exactly their own rows."""
-    from skyline_tpu.ops.dispatch import on_tpu
-
-    return _sfs_round_core(
-        sky_p, count, block, bvalid, active, on_tpu(), _pallas_interpret()
-    )
-
-
-@functools.partial(jax.jit, static_argnames=("old_active", "active"))
-def sfs_cleanup(sky, counts, old_counts, old_active: int, active: int):
-    """After SFS rounds on a buffer that started non-empty: rows of the OLD
-    region (per-partition prefix of ``old_counts``) may be dominated by newly
-    appended rows (which were only guaranteed non-dominated among themselves
-    and not dominated BY the old rows). Prune old-vs-new and re-compact each
-    partition's buffer. ``old_active``/``active`` (static) are the capacity
-    buckets of the old and final max counts — dominator and victim sets are
-    sliced to them so a shrunken skyline in a grown buffer never pays
-    full-capacity passes. Returns (sky', counts')."""
-    from skyline_tpu.ops.dispatch import on_tpu
-
-    use_pallas = on_tpu()
-    interp = _pallas_interpret()
-    P, cap, d = sky.shape
-
-    def core(s, c, old_c):
-        act = lax.slice(s, (0, 0), (active, d))
-        new_ok = (jnp.arange(active) >= old_c) & (jnp.arange(active) < c)
-        old = lax.slice(s, (0, 0), (old_active, d))
-        if use_pallas:
-            from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
-
-            old_dom = dominated_by_pallas(
-                act.T, new_ok, old.T, interpret=interp
-            )
-        else:
-            old_dom = dominated_by(old, act, x_valid=new_ok)
-        old_keep = (jnp.arange(old_active) < old_c) & ~old_dom
-        keep = jnp.zeros((cap,), dtype=bool)
-        keep = keep.at[:active].set(new_ok)
-        keep = keep.at[:old_active].set(old_keep | new_ok[:old_active])
-        return compact(s, keep, cap)
-
-    vals, valid, cnt = jax.vmap(core)(sky, counts, old_counts)
-    return vals, cnt.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("active", "union_cap"))

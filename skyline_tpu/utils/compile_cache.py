@@ -24,7 +24,12 @@ def default_cache_dir() -> str:
     pkg_parent = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    if os.access(pkg_parent, os.W_OK):
+    # only a source checkout gets a repo-local cache (an installed package's
+    # parent is site-packages — writable in a venv, but not ours to pollute)
+    is_checkout = os.path.isfile(os.path.join(pkg_parent, "bench.py")) or (
+        os.path.isdir(os.path.join(pkg_parent, ".git"))
+    )
+    if is_checkout and os.access(pkg_parent, os.W_OK):
         return os.path.join(pkg_parent, ".jax_cache")
     return os.path.join(
         os.path.expanduser("~"), ".cache", "skyline_tpu", "xla"
