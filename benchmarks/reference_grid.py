@@ -66,6 +66,37 @@ def run_cell(algo: str, dims: int, n: int, policy: str, outdir: str,
     }
 
 
+def _run_cell_subprocess(algo, dims, a) -> dict:
+    """One cell in a bounded, retried subprocess: a hung remote dispatch or
+    a transient compile-helper failure (both observed through the tunnel)
+    costs one cell's timeout, not the whole grid."""
+    import subprocess
+    import sys as _sys
+
+    cmd = [_sys.executable, os.path.abspath(__file__),
+           "--cell", f"{algo}:{dims}", "--n", str(a.n),
+           "--outdir", a.outdir, "--policy", a.policy]
+    if a.no_warmup:
+        cmd.append("--no-warmup")
+    last_err = ""
+    for _attempt in range(max(0, a.cell_retries) + 1):
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=a.cell_timeout)
+        except subprocess.TimeoutExpired:
+            last_err = f"cell timed out after {a.cell_timeout:.0f}s"
+            continue
+        if r.returncode == 0:
+            for line in reversed(r.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    return json.loads(line)
+            last_err = f"no JSON in cell output: {r.stdout[-200:]!r}"
+        else:
+            last_err = f"rc={r.returncode}: {(r.stderr or '')[-300:]}"
+    return {"config": f"grid_{algo}_{dims}d", "algo": algo, "dims": dims,
+            "error": last_err[:400]}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--n", type=int, default=1_000_000)
@@ -75,6 +106,11 @@ def main(argv=None):
     ap.add_argument("--skip-figures", action="store_true")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the unmeasured warmup window per cell")
+    ap.add_argument("--cell", help="run ONE cell ('algo:dims') inline and "
+                                   "print its JSON (the subprocess worker)")
+    ap.add_argument("--cell-timeout", type=float, default=1200.0)
+    ap.add_argument("--cell-retries", type=int, default=1,
+                    help="extra attempts after the first (>= 0)")
     a = ap.parse_args(argv)
 
     import jax
@@ -86,28 +122,33 @@ def main(argv=None):
     enable_compile_cache()
 
     os.makedirs(a.outdir, exist_ok=True)
+    if a.cell:
+        algo, _, dims = a.cell.partition(":")
+        out = run_cell(algo, int(dims), a.n, a.policy, a.outdir,
+                       warmup=not a.no_warmup)
+        print(json.dumps(out), flush=True)
+        return 0
+
     results = []
     for dims in DIMS:
         for algo in ALGOS:
-            out = run_cell(algo, dims, a.n, a.policy, a.outdir,
-                           warmup=not a.no_warmup)
+            out = _run_cell_subprocess(algo, dims, a)
             print(json.dumps(out), flush=True)
             results.append(out)
+    ok = [r for r in results if "error" not in r]
     grid_json = os.path.join(a.figdir, "reference_grid.json")
     os.makedirs(a.figdir, exist_ok=True)
     with open(grid_json, "w") as f:
         json.dump({"backend": jax.default_backend(), "results": results}, f,
                   indent=1)
 
-    if not a.skip_figures:
+    if not a.skip_figures and ok:
         from skyline_tpu.plots.paper_figures import main as fig_main
 
-        ours = [
-            f"{r['dims']}:{r['algo']}={r['csv']}" for r in results
-        ]
+        ours = [f"{r['dims']}:{r['algo']}={r['csv']}" for r in ok]
         fig_main(["--ours", *ours,
                   "--prefix", os.path.join(a.figdir, "ours_vs_reference_")])
-    return 0
+    return 0 if len(ok) == len(results) else 1
 
 
 if __name__ == "__main__":

@@ -136,22 +136,35 @@ def main(argv=None):
 
     enable_compile_cache()
     os.makedirs(a.outdir, exist_ok=True)
+    failures = 0
     for name, dist, dims, algo, n in CONFIGS:
         if a.only and a.only not in name:
             continue
-        out = run_tumbling(name, dist, dims, algo, max(10_000, int(n * a.scale)),
-                           a.outdir, policy=a.policy, warmup=not a.no_warmup)
-        print(json.dumps(out))
+        # one config's crash (e.g. a transient remote-compile failure) must
+        # not cost the rest of the matrix — record it and keep going
+        try:
+            out = run_tumbling(name, dist, dims, algo,
+                               max(10_000, int(n * a.scale)),
+                               a.outdir, policy=a.policy,
+                               warmup=not a.no_warmup)
+        except Exception as e:  # noqa: BLE001
+            out = {"config": name, "error": f"{type(e).__name__}: {e}"[:400]}
+            failures += 1
+        print(json.dumps(out), flush=True)
     name, dist, dims, window, slide = SLIDING_CONFIG
     if not a.only or a.only in name:
         # derive slide first and keep window an exact multiple of it
         # (SlidingSkyline requires window_size % slide == 0 at any --scale)
         k = window // slide
         s = max(2_500, int(slide * a.scale))
-        out = run_sliding(name, dist, dims, k * s, s, a.outdir,
-                          warmup=not a.no_warmup)
-        print(json.dumps(out))
-    return 0
+        try:
+            out = run_sliding(name, dist, dims, k * s, s, a.outdir,
+                              warmup=not a.no_warmup)
+        except Exception as e:  # noqa: BLE001
+            out = {"config": name, "error": f"{type(e).__name__}: {e}"[:400]}
+            failures += 1
+        print(json.dumps(out), flush=True)
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -12,6 +12,15 @@ This replaces the reference's tuple-at-a-time BNL loop
 These are pure device kernels (ops layer); the stateful streaming owner is
 ``stream.batched.PartitionSet`` (lazy flush policy), and the single-set
 library form is ``ops.block_skyline.skyline_large``.
+
+The jits donate the ``sky`` buffer so each append round updates the
+full-capacity buffer in place instead of copying it (64 MB/round at the
+north-star window; donation is a no-op with a warning on CPU, filtered in
+tests/conftest.py). Callers must treat the passed-in buffer as consumed —
+every call site reassigns ``sky, counts = sfs_*(sky, counts, ...)``. The
+count carries are NOT donated: they are 4-byte scalars, and callers keep
+references to earlier rounds' counts (``skyline_large``'s lag-2 reads)
+that donation would invalidate.
 """
 
 from __future__ import annotations
@@ -74,7 +83,9 @@ def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
     return sky, count + m
 
 
-@functools.partial(jax.jit, static_argnames=("active",))
+@functools.partial(
+    jax.jit, static_argnames=("active",), donate_argnums=(0,)
+)
 def sfs_round(sky, counts, blocks, bvalids, active: int):
     """Vmapped SFS round over all partitions: sky (P, cap, d), counts (P,)
     int32, blocks (P, B, d), bvalids (P, B) -> (sky', counts'). One device
@@ -91,12 +102,14 @@ def sfs_round(sky, counts, blocks, bvalids, active: int):
     return jax.vmap(core)(sky, counts, blocks, bvalids)
 
 
-@functools.partial(jax.jit, static_argnames=("active",))
+@functools.partial(
+    jax.jit, static_argnames=("active",), donate_argnums=(0,)
+)
 def sfs_round_single(sky_p, count, block, bvalid, active: int):
     """One partition's SFS round without the vmap lane dimension: sky_p
     (cap, d), count () int32, block (B, d), bvalid (B,). Under routing skew
     (one or two partitions holding most of the stream — mr-angle at 8D
-    anti-correlated routes ~96%% of rows to 2 of 8 partitions) the vmapped
+    anti-correlated routes ~96% of rows to 2 of 8 partitions) the vmapped
     round pays P lanes of (B x active) work for one real lane; processing
     the heavy partitions individually costs exactly their own rows."""
     return sfs_round_core(
@@ -104,7 +117,11 @@ def sfs_round_single(sky_p, count, block, bvalid, active: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("old_active", "active"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("old_active", "active"),
+    donate_argnums=(0,),
+)
 def sfs_cleanup(sky, counts, old_counts, old_active: int, active: int):
     """After SFS rounds on a buffer that started non-empty: rows of the OLD
     region (per-partition prefix of ``old_counts``) may be dominated by newly

@@ -160,7 +160,7 @@ def global_merge_stats_device(sky, counts, active: int, union_cap: int):
     ``active`` (static) bounds each partition's copied prefix (the bucket
     of the max count); ``union_cap`` (static) is the bucket of the summed
     counts — the dominance pass runs over the union's size, NOT P x active.
-    Under routing skew (mr-angle at 8D sends ~96%% of rows to 2 of 8
+    Under routing skew (mr-angle at 8D sends ~96% of rows to 2 of 8
     partitions) the flattened-padded formulation pays (P*active)^2 while
     the union is barely bigger than one partition — a 16x difference at the
     north-star window.

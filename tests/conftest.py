@@ -22,6 +22,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import warnings
+
+# ops.sfs jits donate their sky buffers (in-place append rounds on TPU);
+# the CPU backend does not implement donation and warns per compile
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
 import numpy as np
 import pytest
 
