@@ -62,6 +62,20 @@ def get_lib():
             ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_int64),
         ]
+        # produce-plane helpers (absent from pre-rework .so builds, hence
+        # the hasattr guards in the accessors below)
+        if hasattr(lib, "sky_crc32c"):
+            lib.sky_crc32c.restype = ctypes.c_uint32
+            lib.sky_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        if hasattr(lib, "sky_encode_records"):
+            lib.sky_encode_records.restype = ctypes.c_int64
+            lib.sky_encode_records.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+            ]
         _lib = lib
     return _lib
 
@@ -85,3 +99,38 @@ def parse_tuples_native(text: bytes, dims: int, max_rows: int):
         ctypes.byref(dropped),
     )
     return ids[:n], values[:n], int(dropped.value)
+
+
+def crc32c_native(data: bytes):
+    """CRC32C (Castagnoli) via the native lib (hardware CRC instruction on
+    x86); None if the library or symbol is unavailable."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sky_crc32c"):
+        return None
+    return int(lib.sky_crc32c(data, len(data)))
+
+
+def encode_records_native(values: list[bytes]):
+    """Kafka RecordBatch v2 record frames for value-only records (the
+    produce-plane hot loop); None if unavailable. Byte-identical to the
+    Python loop in bridge/kafkalite/protocol.py (golden-bytes tested)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sky_encode_records"):
+        return None
+    n = len(values)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in values], out=offsets[1:])
+    blob = b"".join(values)
+    # frame overhead per record: <=2B length + 3 fixed + <=2B offsetDelta
+    # + <=2B valueLen + 1 header count, padded generously
+    out = np.empty(offsets[-1] + 24 * n + 64, dtype=np.uint8)
+    w = lib.sky_encode_records(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.shape[0],
+    )
+    if w < 0:
+        return None
+    return out[:w].tobytes()

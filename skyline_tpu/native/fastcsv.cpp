@@ -126,3 +126,119 @@ int64_t sky_parse_tuples(const char* buf, int64_t len, int32_t dims,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Kafka RecordBatch v2 produce-plane helpers (see bridge/kafkalite/protocol.py
+// encode_record_batch): CRC32C over the post-crc batch region and the
+// per-record frame loop for value-only records. Both byte-identical to the
+// Python fallbacks — the golden-bytes tests pin the format.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint32_t crc32c_table[8][256];
+bool crc32c_table_ready = false;
+
+void crc32c_init() {
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+        crc32c_table[0][i] = c;
+    }
+    for (int t = 1; t < 8; ++t)
+        for (uint32_t i = 0; i < 256; ++i)
+            crc32c_table[t][i] =
+                crc32c_table[0][crc32c_table[t - 1][i] & 0xFF] ^
+                (crc32c_table[t - 1][i] >> 8);
+    crc32c_table_ready = true;
+}
+
+uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, int64_t n) {
+    if (!crc32c_table_ready) crc32c_init();
+    while (n >= 8) {
+        crc ^= static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+               (static_cast<uint32_t>(p[2]) << 16) |
+               (static_cast<uint32_t>(p[3]) << 24);
+        crc = crc32c_table[7][crc & 0xFF] ^ crc32c_table[6][(crc >> 8) & 0xFF] ^
+              crc32c_table[5][(crc >> 16) & 0xFF] ^
+              crc32c_table[4][(crc >> 24) & 0xFF] ^ crc32c_table[3][p[4]] ^
+              crc32c_table[2][p[5]] ^ crc32c_table[1][p[6]] ^
+              crc32c_table[0][p[7]];
+        p += 8;
+        n -= 8;
+    }
+    while (n-- > 0) crc = crc32c_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    return crc;
+}
+
+// LEB128 of an already-zigzagged value; returns bytes written.
+inline int put_uvarint(uint8_t* out, uint64_t z) {
+    int i = 0;
+    while (z >= 0x80) {
+        out[i++] = static_cast<uint8_t>(z) | 0x80;
+        z >>= 7;
+    }
+    out[i++] = static_cast<uint8_t>(z);
+    return i;
+}
+
+inline int uvarint_len(uint64_t z) {
+    int i = 1;
+    while (z >= 0x80) {
+        z >>= 7;
+        ++i;
+    }
+    return i;
+}
+
+}  // namespace
+
+extern "C" uint32_t sky_crc32c(const uint8_t* data, int64_t n) {
+#if defined(__SSE4_2__)
+    uint32_t crc = 0xFFFFFFFFu;
+    const uint8_t* p = data;
+    while (n >= 8) {
+        uint64_t v;
+        std::memcpy(&v, p, 8);
+        crc = static_cast<uint32_t>(__builtin_ia32_crc32di(crc, v));
+        p += 8;
+        n -= 8;
+    }
+    while (n-- > 0) crc = __builtin_ia32_crc32qi(crc, *p++);
+    return crc ^ 0xFFFFFFFFu;
+#else
+    return crc32c_sw(0xFFFFFFFFu, data, n) ^ 0xFFFFFFFFu;
+#endif
+}
+
+// Encode n value-only records (key=null, timestampDelta=0, offsetDelta=i,
+// no headers) into `out`. `values` is the concatenation of the value byte
+// strings; `offsets` has n+1 prefix offsets. Returns bytes written, or -1
+// if out_cap would be exceeded (caller sizes out generously).
+extern "C" int64_t sky_encode_records(const uint8_t* values,
+                                      const int64_t* offsets, int64_t n,
+                                      uint8_t* out, int64_t out_cap) {
+    int64_t w = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t vlen = offsets[i + 1] - offsets[i];
+        // body: attributes(1) + tsDelta(1) + offsetDelta + keyLen(1=null)
+        //       + valueLen + value + headerCount(1)
+        const uint64_t off_z = static_cast<uint64_t>(i) << 1;
+        const uint64_t vlen_z = static_cast<uint64_t>(vlen) << 1;
+        const int64_t body = 3 + uvarint_len(off_z) + uvarint_len(vlen_z) +
+                             vlen + 1;
+        const uint64_t body_z = static_cast<uint64_t>(body) << 1;
+        if (w + uvarint_len(body_z) + body > out_cap) return -1;
+        w += put_uvarint(out + w, body_z);
+        out[w++] = 0x00;  // attributes
+        out[w++] = 0x00;  // timestampDelta = 0
+        w += put_uvarint(out + w, off_z);
+        out[w++] = 0x01;  // key = null (zigzag(-1))
+        w += put_uvarint(out + w, vlen_z);
+        std::memcpy(out + w, values + offsets[i], static_cast<size_t>(vlen));
+        w += vlen;
+        out[w++] = 0x00;  // headers count
+    }
+    return w;
+}

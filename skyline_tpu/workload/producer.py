@@ -89,11 +89,15 @@ def main(argv=None):
         n = args.batch if args.count == 0 else min(args.batch, end_id - record_id)
         vals = generate(distribution, rng, n, args.dims, args.d_min, args.d_max)
         ids = np.arange(record_id, record_id + n, dtype=np.int64)
-        # integer-valued floats print without trailing .0 via int cast
-        lines = [
-            str(i) + "," + ",".join(str(int(v)) for v in row)
-            for i, row in zip(ids, vals)
-        ]
+        # integer-valued floats print without trailing .0 via int cast;
+        # vectorized column-wise formatting (np.char) — the per-value Python
+        # loop was the producer's bottleneck once the produce plane went
+        # native (benchmarks/e2e_transport.py)
+        arr = ids.astype(str)
+        iv = vals.astype(np.int64)
+        for k in range(args.dims):
+            arr = np.char.add(np.char.add(arr, ","), iv[:, k].astype(str))
+        lines = arr.tolist()
         send(args.topic, lines)
         record_id += n
         while args.query_threshold > 0 and record_id >= next_trigger:
