@@ -73,8 +73,6 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     P, cap, d, B, active = 8, 65536, 8, 8192, 32768
-    sky = jnp.asarray(np.full((P, cap, d), np.inf, np.float32))
-    counts = jnp.asarray(np.zeros(P, np.int32))
     blocks = []
     for _ in range(8):
         blk = np.stack(

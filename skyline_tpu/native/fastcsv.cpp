@@ -136,26 +136,26 @@ int64_t sky_parse_tuples(const char* buf, int64_t len, int32_t dims,
 
 namespace {
 
-uint32_t crc32c_table[8][256];
-bool crc32c_table_ready = false;
-
-void crc32c_init() {
-    for (uint32_t i = 0; i < 256; ++i) {
-        uint32_t c = i;
-        for (int k = 0; k < 8; ++k)
-            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
-        crc32c_table[0][i] = c;
+struct Crc32cTables {
+    uint32_t t[8][256];
+    Crc32cTables() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+            t[0][i] = c;
+        }
+        for (int k = 1; k < 8; ++k)
+            for (uint32_t i = 0; i < 256; ++i)
+                t[k][i] = t[0][t[k - 1][i] & 0xFF] ^ (t[k - 1][i] >> 8);
     }
-    for (int t = 1; t < 8; ++t)
-        for (uint32_t i = 0; i < 256; ++i)
-            crc32c_table[t][i] =
-                crc32c_table[0][crc32c_table[t - 1][i] & 0xFF] ^
-                (crc32c_table[t - 1][i] >> 8);
-    crc32c_table_ready = true;
-}
+};
 
 uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, int64_t n) {
-    if (!crc32c_table_ready) crc32c_init();
+    // C++11 guarantees thread-safe one-time construction of local statics
+    // (ctypes releases the GIL, so concurrent first calls are real)
+    static const Crc32cTables tables;
+    const auto& crc32c_table = tables.t;
     while (n >= 8) {
         crc ^= static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
                (static_cast<uint32_t>(p[2]) << 16) |

@@ -202,9 +202,13 @@ def skyline_large(
     reads the survivor count of round r-2 — work the device already
     finished while later rounds queued — so the dominator bucket tracks the
     true skyline size (O(N*(S+B)) total) without ever stalling the dispatch
-    pipeline on a high-latency device link. Measured on the 1M x 8D
-    anti-correlated window: ~74 s for the old per-block-synced XLA form vs
-    ~6 s for this one (artifacts/kernels_tpu.json).
+    pipeline on a high-latency device link. The old per-block-synced XLA
+    form measured 74 s on the 1M x 8D anti-correlated window
+    (artifacts/kernels_tpu.json); this form runs the same kernels/shapes as
+    the engine's SFS flush, which does that window's whole local phase in
+    ~4.9 s (artifacts/bench_tpu.json phase_breakdown_ms) — the refreshed
+    skyline_large row lands in kernels_tpu.json with the next TPU
+    microbench run.
 
     ``block=0`` scales the block with N on TPU (the same heuristic as the
     streaming engine's skewed-partition path: fewer dispatches for big

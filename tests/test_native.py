@@ -103,3 +103,58 @@ def test_wire_uses_native_when_available(rng):
     lines.insert(10, "bogus,line")
     ids, vals, dropped = parse_tuple_lines(lines, 3)
     assert len(ids) == 50 and dropped == 1
+
+
+@needs_native
+def test_native_crc32c_matches_python(rng):
+    from skyline_tpu.bridge.kafkalite.protocol import _crc32c_py
+
+    assert native.crc32c_native(b"") == _crc32c_py(b"")
+    # RFC 3720 check vector
+    assert native.crc32c_native(b"\x00" * 32) == 0x8A9136AA
+    for n in (1, 7, 8, 9, 63, 64, 65, 1000):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        assert native.crc32c_native(data) == _crc32c_py(data), n
+
+
+@needs_native
+def test_native_record_frames_byte_identical(rng):
+    """The C record-frame encoder must emit exactly the Python loop's bytes
+    for value-only records (incl. empty values and multi-byte varints)."""
+    from skyline_tpu.bridge.kafkalite.protocol import _uvarint
+
+    values = [b"", b"x", b"9,5.5", b"v" * 200, b"w" * 20000]
+    values += [str(i).encode() * (i % 5) for i in range(300)]
+    got = native.encode_records_native(values)
+    parts = []
+    for i, value in enumerate(values):
+        rb = b"\x00\x00" + _uvarint(i << 1)
+        rb += b"\x01" + _uvarint(len(value) << 1) + value + b"\x00"
+        parts.append(_uvarint(len(rb) << 1) + rb)
+    assert got == b"".join(parts)
+
+
+def test_encode_record_batch_keyed_records_keep_python_path():
+    """Keyed records bypass the native value-only fast path and still
+    round-trip (decode is format-agnostic)."""
+    from skyline_tpu.bridge.kafkalite import protocol as P
+
+    records = [(b"k1", b"v1"), (None, b"v2")]
+    blob = P.encode_record_batch(records, base_offset=3)
+    assert P.decode_record_batches(blob) == [(3, b"k1", b"v1"), (4, None, b"v2")]
+
+
+def test_consumer_check_crcs_detects_corruption():
+    """check_crcs=True must reject a corrupted batch end-to-end."""
+    import pytest
+
+    from skyline_tpu.bridge.kafkalite import protocol as P
+
+    blob = bytearray(P.encode_record_batch([(None, b"payload")]))
+    blob[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC32C"):
+        P.decode_record_batches(bytes(blob), verify_crc=True)
+    # and the default decode path (verify_crc=False callers) still parses
+    # the (corrupt) frame rather than crashing
+    out = P.decode_record_batches(bytes(blob), verify_crc=False)
+    assert len(out) == 1
