@@ -39,28 +39,35 @@ cat /tmp/qos_row.jsonl
 timeout 3600 python benchmarks/run_configs.py --scale 1 --outdir bench_out_tpu \
   --only sliding > /tmp/sliding_row.jsonl || echo "SLIDING rc=$?"
 cat /tmp/sliding_row.jsonl
-# merge only rows that parse as JSON (a timeout can truncate mid-line),
-# and only if the 4-row baseline artifact is present to merge into
+# merge only rows that parse as JSON (a timeout can truncate mid-line);
+# the already-recorded baseline rows are kept when present
 if [ -f artifacts/baseline_matrix.jsonl ]; then
   head -4 artifacts/baseline_matrix.jsonl > /tmp/bm.jsonl
-  python - <<'PYEOF'
+else
+  : > /tmp/bm.jsonl
+fi
+python - <<'PYEOF'
 import json
 rows = []
 for p in ("/tmp/qos_row.jsonl", "/tmp/sliding_row.jsonl"):
     try:
         with open(p) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    rows.append(json.loads(line))
-    except (OSError, ValueError):
-        pass
+            lines = f.readlines()
+    except OSError:
+        continue
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            pass  # truncated/non-JSON line: skip it, keep later rows
 with open("/tmp/bm.jsonl", "a") as f:
     for r in rows:
         f.write(json.dumps(r) + "\n")
 PYEOF
-  mv /tmp/bm.jsonl artifacts/baseline_matrix.jsonl
-fi
+mv /tmp/bm.jsonl artifacts/baseline_matrix.jsonl
 
 echo "--- transport-inclusive e2e (2D + 8D, 1M)"
 timeout 7200 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8 \

@@ -48,6 +48,14 @@ from skyline_tpu.stream.window import (
 )
 
 
+# Sequential-SFS probe block: rounds start at this size so a small-skyline
+# partition never pays big-block dominance work; the loop escalates to the
+# row-scaled block once a round's surviving count exceeds half a block
+# (a probe round keeps at most B survivors, so half-a-block survival is
+# strong evidence of a large skyline).
+_PROBE_B = 8192
+
+
 class PartitionSet:
     """Device-stacked state for ``num_partitions`` logical partitions.
 
@@ -313,9 +321,21 @@ class PartitionSet:
         B = _next_pow2(min(max_rows, max(self.buffer_size, 8192)))
         n_rounds = -(-max_rows // B)
         counts = self._count_dev
+        # lag-2 tightening: the rows-streamed bound on _count_ub grows
+        # linearly, but the true skyline may stay tiny (uniform/correlated
+        # streams); reading the count vector from two rounds back — work
+        # the device already drained while later rounds queued — keeps the
+        # active bucket near the true size without stalling the pipeline
+        prev: list[tuple] = []  # (counts_dev_after_round, widths_of_round)
         for rnd in range(n_rounds):
             with self.tracer.phase("flush/assemble"):
                 batch, bvalid, widths = self._round_batch(rows, rnd, B)
+            if len(prev) >= 2:
+                c2, w1 = prev[-2][0], prev[-1][1]
+                self._count_ub = np.minimum(
+                    self._count_ub,
+                    np.asarray(c2, dtype=np.int64) + w1,
+                )
             # the SFS append writes a full B-row block at offset count, so
             # capacity must cover count + B for every partition
             need = int(self._count_ub.max()) + B
@@ -336,6 +356,7 @@ class PartitionSet:
                 )
                 if self.tracer.sync_device:
                     np.asarray(counts)
+            prev.append((counts, widths))
             self._count_ub = np.minimum(self._cap, self._count_ub + widths)
         self._count_dev = counts
         return counts
@@ -352,10 +373,13 @@ class PartitionSet:
         row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
 
         def _seq_block(rows_p: int) -> int:
-            # scale the block with the partition: a ~500k-row heavy
-            # partition runs 8 rounds at B=64k instead of 30 at 16k (the
-            # self-prune cost grows only linearly in B, dispatch latency
-            # through the tunnel per round is the real price)
+            # the large-skyline block: a ~500k-row heavy partition runs 8
+            # rounds at B=64k instead of 30 at 16k (the self-prune cost
+            # grows only linearly in B, dispatch latency through the tunnel
+            # per round is the real price). Only used once the running
+            # count has PROVEN large — per-round work is B x bucket(S + B),
+            # so big blocks on a small-skyline stream multiply total work
+            # for nothing (uniform 4D: S ~ 500 of 500k rows).
             return _next_pow2(
                 min(
                     max(rows_p, 1),
@@ -376,11 +400,28 @@ class PartitionSet:
             cnt_p = self._count_dev[p]
             ub_p = int(counts_host[p])
             if rp.shape[0]:
-                B = _seq_block(rp.shape[0])
-                for rnd in range(-(-rp.shape[0] // B)):
+                # start at the probe block; escalate to the big block only
+                # once the running count proves the skyline is large (a
+                # known-large prior skyline escalates immediately)
+                B_big = _seq_block(rp.shape[0])
+                B = B_big if ub_p > _PROBE_B // 2 else min(_PROBE_B, B_big)
+                # lag-2 tightening (see _sfs_vmapped): low-skyline heavy
+                # partitions would otherwise pay active buckets that track
+                # rows streamed instead of survivors
+                prev: list[tuple] = []
+                off = 0
+                while off < rp.shape[0]:
+                    if len(prev) >= 2:
+                        c2, w1 = prev[-2][0], prev[-1][1]
+                        ub_p = min(ub_p, int(c2) + w1)
+                        # escalate once survival proves high: a probe
+                        # round keeps <= B survivors, so compare against
+                        # half a block (uniform keeps ~1% and never trips)
+                        if B < B_big and int(c2) > B // 2:
+                            B = B_big
                     with self.tracer.phase("flush/assemble"):
                         block, bvalid, w = self._pad_block(
-                            rp[rnd * B : (rnd + 1) * B], B
+                            rp[off : off + B], B
                         )
                     active = min(
                         self._cap, _next_pow2(max(ub_p, 1))
@@ -394,7 +435,9 @@ class PartitionSet:
                         )
                         if self.tracer.sync_device:
                             np.asarray(cnt_p)
+                    prev.append((cnt_p, w))
                     ub_p = min(self._cap, ub_p + w)
+                    off += w
             new_skies.append(sky_p)
             new_counts.append(cnt_p)
             self._count_ub[p] = ub_p
