@@ -224,6 +224,36 @@ def run_child(backend: str, timeout_s: float) -> tuple[dict | None, str]:
     return None, f"{backend} child emitted no JSON: {r.stdout[-300:]!r}"
 
 
+def _attach_last_tpu_run(result: dict) -> None:
+    """Best-effort: surface the last recorded TPU measurement (committed
+    artifact) so a tunnel outage at bench time doesn't hide the real
+    number. Never raises — the primary result line must survive any
+    artifact corruption."""
+    tpu_artifact = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts",
+        "bench_tpu.json",
+    )
+    try:
+        with open(tpu_artifact) as f:
+            last = json.load(f)
+        if not isinstance(last, dict):
+            return
+        result["last_recorded_tpu_run"] = {
+            k: last[k]
+            for k in (
+                "value",
+                "vs_baseline",
+                "p50_window_latency_ms",
+                "phase_breakdown_ms",
+            )
+            if k in last
+        }
+        result["last_recorded_tpu_artifact"] = "artifacts/bench_tpu.json"
+    except (OSError, ValueError):
+        pass
+
+
 def main() -> None:
     from skyline_tpu.utils.backend_probe import probe_backend
 
@@ -276,24 +306,23 @@ def main() -> None:
             if errors
             else "forced CPU run"
         )
+        _attach_last_tpu_run(result)
         print(json.dumps(result))
         return
     errors.append(err)
 
     # total failure: still exactly one parseable JSON line
-    print(
-        json.dumps(
-            {
-                "metric": "skyline tuples/sec, 8D anti-correlated windows",
-                "value": 0,
-                "unit": "tuples/s",
-                "vs_baseline": 0,
-                "backend": None,
-                "diagnosis": "benchmark failed on all backends",
-                "orchestrator_errors": errors[-6:],
-            }
-        )
-    )
+    failure = {
+        "metric": "skyline tuples/sec, 8D anti-correlated windows",
+        "value": 0,
+        "unit": "tuples/s",
+        "vs_baseline": 0,
+        "backend": None,
+        "diagnosis": "benchmark failed on all backends",
+        "orchestrator_errors": errors[-6:],
+    }
+    _attach_last_tpu_run(failure)
+    print(json.dumps(failure))
     sys.exit(0)  # the JSON line IS the result; don't mask it with rc!=0
 
 
