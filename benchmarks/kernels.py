@@ -230,6 +230,74 @@ def bench_parse(reps: int, results: dict) -> None:
         }
 
 
+def bench_transport(results: dict) -> None:
+    """Produce/consume throughput through the kafkalite broker over real
+    TCP — the artifact behind the transport-rate claims (native CRC32C +
+    record framing on produce, inlined varint decode on fetch). Records an
+    ``error`` entry instead of wedging if the broker can't start or the
+    stream stalls."""
+    import time as _time
+
+    # one process-supervision implementation: the deployment launcher owns
+    # it (PYTHONPATH/cwd pinning, log capture, SIGTERM+wait+kill stop)
+    from deploy.launch import Stack, wait_for_broker
+    from skyline_tpu.bridge.kafka import KafkaBus
+
+    port = 19901
+    log_dir = os.path.join("/tmp", f"kernels_transport_{os.getpid()}")
+    stack = Stack(log_dir)
+    try:
+        stack.start(
+            "broker",
+            ["-m", "skyline_tpu.bridge.kafkalite.broker",
+             "--host", "127.0.0.1", "--port", str(port)],
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        wait_for_broker(f"127.0.0.1:{port}")
+        crashed = stack.poll_crashed()
+        if crashed:
+            raise RuntimeError(crashed)
+        bus = KafkaBus(f"127.0.0.1:{port}")
+        rng = np.random.default_rng(5)
+        # pid-unique topics: a stale broker from a killed prior run must
+        # not contribute its old records to this run's measurement
+        run_tag = os.getpid()
+        for d in (2, 8):
+            n = 200_000
+            vals = rng.uniform(0, 10000, (n, d)).astype(np.int64)
+            lines = [
+                f"{i}," + ",".join(map(str, row))
+                for i, row in enumerate(vals.tolist())
+            ]
+            topic = f"bench-{run_tag}-{d}"
+            t0 = _time.perf_counter()
+            bus.produce_many(topic, lines)
+            tp = _time.perf_counter() - t0
+            cons = bus.consumer(topic, from_beginning=True)
+            t0 = _time.perf_counter()
+            got = 0
+            deadline = t0 + 120.0
+            while got < n:
+                got += len(cons.poll(max_records=1 << 20))
+                if _time.perf_counter() > deadline:
+                    raise RuntimeError(
+                        f"consume stalled: {got}/{n} records in 120s"
+                    )
+            tc = _time.perf_counter() - t0
+            results[f"kafkalite_produce/lines={n}/d={d}"] = {
+                "ms": round(tp * 1000, 1),
+                "klines_per_s": round(n / tp / 1e3, 1),
+            }
+            results[f"kafkalite_consume/lines={n}/d={d}"] = {
+                "ms": round(tc * 1000, 1),
+                "klines_per_s": round(n / tc / 1e3, 1),
+            }
+    except Exception as e:  # noqa: BLE001
+        results["kafkalite_transport"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        stack.stop()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reps", type=int, default=5)
@@ -238,7 +306,7 @@ def main() -> None:
     ap.add_argument(
         "--only",
         default=None,
-        help="comma list from: masks,flush,rect,sfs,parse",
+        help="comma list from: masks,flush,rect,sfs,parse,transport",
     )
     args = ap.parse_args()
 
@@ -270,6 +338,8 @@ def main() -> None:
         bench_sfs(args.reps, args.d, results)
     if want("parse"):
         bench_parse(args.reps, results)
+    if want("transport"):
+        bench_transport(results)
 
     doc = {"meta": meta, "results": results}
     out = json.dumps(doc, indent=1)
