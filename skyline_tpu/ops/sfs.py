@@ -117,41 +117,48 @@ def sfs_round_single(sky_p, count, block, bvalid, active: int):
     )
 
 
+def sfs_cleanup_core(s, c, old_c, old_active, active, use_pallas, interp):
+    """One partition's old-vs-new prune after SFS rounds on non-empty
+    initial state: old rows (prefix ``old_c``) may be dominated by newly
+    appended rows (guaranteed non-dominated among themselves and not
+    dominated BY the old rows); prune and re-compact. Returns
+    (vals (cap, d), count)."""
+    cap, d = s.shape
+    act = lax.slice(s, (0, 0), (active, d))
+    new_ok = (jnp.arange(active) >= old_c) & (jnp.arange(active) < c)
+    old = lax.slice(s, (0, 0), (old_active, d))
+    if use_pallas:
+        from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
+
+        old_dom = dominated_by_pallas(act.T, new_ok, old.T, interpret=interp)
+    else:
+        old_dom = dominated_by(old, act, x_valid=new_ok)
+    old_keep = (jnp.arange(old_active) < old_c) & ~old_dom
+    keep = jnp.zeros((cap,), dtype=bool)
+    keep = keep.at[:active].set(new_ok)
+    keep = keep.at[:old_active].set(old_keep | new_ok[:old_active])
+    vals, _, cnt = compact(s, keep, cap)
+    return vals, cnt.astype(jnp.int32)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("old_active", "active"),
     donate_argnums=(0,),
 )
 def sfs_cleanup(sky, counts, old_counts, old_active: int, active: int):
-    """After SFS rounds on a buffer that started non-empty: rows of the OLD
-    region (per-partition prefix of ``old_counts``) may be dominated by newly
-    appended rows (which were only guaranteed non-dominated among themselves
-    and not dominated BY the old rows). Prune old-vs-new and re-compact each
-    partition's buffer. ``old_active``/``active`` (static) are the capacity
-    buckets of the old and final max counts — dominator and victim sets are
-    sliced to them so a shrunken skyline in a grown buffer never pays
-    full-capacity passes. Returns (sky', counts')."""
+    """Vmapped ``sfs_cleanup_core`` over all partitions.
+    ``old_active``/``active`` (static) are the capacity buckets of the old
+    and final max counts — dominator and victim sets are sliced to them so
+    a shrunken skyline in a grown buffer never pays full-capacity passes.
+    Returns (sky', counts')."""
     use_pallas = on_tpu()
     interp = pallas_interpret()
-    P, cap, d = sky.shape
 
     def core(s, c, old_c):
-        act = lax.slice(s, (0, 0), (active, d))
-        new_ok = (jnp.arange(active) >= old_c) & (jnp.arange(active) < c)
-        old = lax.slice(s, (0, 0), (old_active, d))
-        if use_pallas:
-            from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
+        return sfs_cleanup_core(
+            s, c, old_c, old_active, active, use_pallas, interp
+        )
 
-            old_dom = dominated_by_pallas(
-                act.T, new_ok, old.T, interpret=interp
-            )
-        else:
-            old_dom = dominated_by(old, act, x_valid=new_ok)
-        old_keep = (jnp.arange(old_active) < old_c) & ~old_dom
-        keep = jnp.zeros((cap,), dtype=bool)
-        keep = keep.at[:active].set(new_ok)
-        keep = keep.at[:old_active].set(old_keep | new_ok[:old_active])
-        return compact(s, keep, cap)
-
-    vals, valid, cnt = jax.vmap(core)(sky, counts, old_counts)
-    return vals, cnt.astype(jnp.int32)
+    vals, cnt = jax.vmap(core)(sky, counts, old_counts)
+    return vals, cnt

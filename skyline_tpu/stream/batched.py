@@ -42,6 +42,8 @@ from skyline_tpu.stream.window import (
     global_points_device,
     merge_step_active,
     meshed_merge_step,
+    meshed_sfs_cleanup,
+    meshed_sfs_round,
     sfs_cleanup,
     sfs_round,
     sfs_round_single,
@@ -96,8 +98,12 @@ class PartitionSet:
           buffer re-pruning, no full-buffer compaction. For
           tumbling-window-then-query streams this does a fraction of the
           incremental policy's dominance work (see stream/window.py SFS
-          notes). Results are identical (the merge law). Requires
-          ``mesh=None`` (the SFS rounds are single-device vmapped kernels).
+          notes). Results are identical (the merge law). Under a ``mesh``
+          the rounds run SPMD via ``shard_map`` over the partition axis
+          (one launch, each chip appending to its resident partitions; the
+          skew-sequential path and the device-side global merge are
+          single-device specializations, so the meshed flush always uses
+          the vmapped rounds and the engine's host-side global merge).
         """
         self.num_partitions = num_partitions
         self.dims = dims
@@ -106,8 +112,6 @@ class PartitionSet:
         self.tracer = tracer if tracer is not None else NULL_TRACER
         if flush_policy not in ("incremental", "lazy"):
             raise ValueError(f"unknown flush_policy {flush_policy!r}")
-        if flush_policy == "lazy" and mesh is not None:
-            raise ValueError("flush_policy='lazy' requires mesh=None")
         self.flush_policy = flush_policy
         self.mesh = mesh
         if mesh is not None:
@@ -348,12 +352,20 @@ class PartitionSet:
                 self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/device_put"):
-                batch_dev = jnp.asarray(batch)
-                bvalid_dev = jnp.asarray(bvalid)
+                batch_dev = self._put(batch)
+                bvalid_dev = self._put(bvalid)
             with self.tracer.phase("flush/merge_kernel"):
-                self.sky, counts = sfs_round(
-                    self.sky, counts, batch_dev, bvalid_dev, active
-                )
+                if self.mesh is not None:
+                    rnd_fn = meshed_sfs_round(
+                        self.mesh, self.mesh.axis_names[0], on_tpu(), active
+                    )
+                    self.sky, counts = rnd_fn(
+                        self.sky, counts, batch_dev, bvalid_dev
+                    )
+                else:
+                    self.sky, counts = sfs_round(
+                        self.sky, counts, batch_dev, bvalid_dev, active
+                    )
                 if self.tracer.sync_device:
                     np.asarray(counts)
             prev.append((counts, widths))
@@ -487,7 +499,9 @@ class PartitionSet:
         # costs ~total_rows. Under routing skew (mr-angle at 8D sends ~96%
         # of rows to 2 of 8 partitions) sequential wins by ~P/2; balanced
         # streams keep the one-launch-per-round batching.
-        if self.num_partitions * max_rows > 2 * total_rows:
+        if self.mesh is None and (
+            self.num_partitions * max_rows > 2 * total_rows
+        ):
             counts = self._sfs_sequential(rows)
         else:
             counts = self._sfs_vmapped(rows, max_rows)
@@ -499,10 +513,19 @@ class PartitionSet:
                 self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/merge_kernel"):
-                self.sky, counts = sfs_cleanup(
-                    self.sky, counts, jnp.asarray(old_counts),
-                    old_active, active,
-                )
+                if self.mesh is not None:
+                    cl = meshed_sfs_cleanup(
+                        self.mesh, self.mesh.axis_names[0], on_tpu(),
+                        old_active, active,
+                    )
+                    self.sky, counts = cl(
+                        self.sky, counts, self._put(old_counts)
+                    )
+                else:
+                    self.sky, counts = sfs_cleanup(
+                        self.sky, counts, jnp.asarray(old_counts),
+                        old_active, active,
+                    )
                 if self.tracer.sync_device:
                     np.asarray(counts)
         self._count_dev = counts

@@ -65,7 +65,7 @@ class EngineConfig:
     # reference's processBuffer model); "lazy": accumulate and compute at
     # query time via append-only SFS rounds — far less total work for
     # tumbling-window-then-query streams (see stream/batched.py). Identical
-    # results either way; lazy requires mesh=None.
+    # results either way; under a mesh the lazy rounds run shard_map SPMD.
     flush_policy: str = "incremental"
 
     @property

@@ -210,28 +210,73 @@ def global_points_device(union, keep, out_cap: int):
     return compact(union, keep, out_cap)[0]
 
 
-@functools.lru_cache(maxsize=None)
-def meshed_merge_step(mesh, axis: str, use_pallas: bool, out_cap: int):
-    """Batched merge wrapped in ``shard_map`` over the partition axis.
-
-    With partition state sharded ``(P, cap, d)`` across a mesh, the plain
-    jitted vmap relies on GSPMD auto-partitioning — fine for the XLA merge,
-    but ``pallas_call`` has no partitioning rule, so the Pallas variant must
-    be explicitly SPMD: each device runs the vmapped merge on its resident
-    partitions (the merge has no cross-partition data flow, so no
-    collectives are needed). Cached per (mesh, axis, kernel, capacity
-    bucket) so steady-state flushes reuse one executable.
-    """
+def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):
+    """``jit(shard_map(vmap(fn)))`` over the partition axis — the one shared
+    wrapper for every meshed per-partition kernel. All inputs and outputs
+    are partition-sharded; the per-partition kernels have no cross-partition
+    data flow, so no collectives appear and each device runs its resident
+    partitions only. Needed explicitly (vs GSPMD) because ``pallas_call``
+    has no auto-partitioning rule."""
     from jax.sharding import PartitionSpec
 
-    core = _merge_step_pallas_core if use_pallas else _merge_step_core
-    vm = jax.vmap(lambda s, sv, b, bv: core(s, sv, b, bv, out_cap))
     spec = PartitionSpec(axis)
     sharded = jax.shard_map(
-        vm,
+        jax.vmap(fn),
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec),
-        out_specs=(spec, spec, spec),
+        in_specs=(spec,) * n_in,
+        out_specs=(spec,) * n_out if n_out > 1 else spec,
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=donate)
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_merge_step(mesh, axis: str, use_pallas: bool, out_cap: int):
+    """Batched merge wrapped in ``shard_map`` over the partition axis
+    (see ``_shard_map_vmapped``). Cached per (mesh, axis, kernel, capacity
+    bucket) so steady-state flushes reuse one executable."""
+    core = _merge_step_pallas_core if use_pallas else _merge_step_core
+    return _shard_map_vmapped(
+        mesh, axis, lambda s, sv, b, bv: core(s, sv, b, bv, out_cap), 4, 3
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_sfs_round(mesh, axis: str, use_pallas: bool, active: int):
+    """``sfs_round`` wrapped in ``shard_map`` over the partition axis (see
+    ``_shard_map_vmapped``) — the lazy policy's meshed flush. Cached per
+    (mesh, axis, kernel, active bucket); donates the sky buffer like the
+    single-device jit."""
+    from skyline_tpu.ops.sfs import pallas_interpret, sfs_round_core
+
+    interp = pallas_interpret()
+    return _shard_map_vmapped(
+        mesh,
+        axis,
+        lambda s, c, b, bv: sfs_round_core(
+            s, c, b, bv, active, use_pallas, interp
+        ),
+        4,
+        2,
+        donate=(0,),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_sfs_cleanup(mesh, axis: str, use_pallas: bool, old_active: int, active: int):
+    """``sfs_cleanup`` wrapped in ``shard_map`` over the partition axis —
+    the old-vs-new prune after SFS rounds on non-empty initial state, per
+    resident partition (no collectives)."""
+    from skyline_tpu.ops.sfs import pallas_interpret, sfs_cleanup_core
+
+    interp = pallas_interpret()
+    return _shard_map_vmapped(
+        mesh,
+        axis,
+        lambda s, c, oc: sfs_cleanup_core(
+            s, c, oc, old_active, active, use_pallas, interp
+        ),
+        3,
+        2,
+        donate=(0,),
+    )

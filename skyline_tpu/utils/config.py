@@ -72,8 +72,6 @@ class JobConfig:
             )
         if self.mesh < 0:
             raise ValueError(f"mesh must be >= 0, got {self.mesh}")
-        if self.mesh and self.flush_policy == "lazy":
-            raise ValueError("flush_policy='lazy' requires mesh=0 (single device)")
         # the over-partitioning factor is owned by EngineConfig; validate
         # against it rather than a duplicated literal
         num_partitions = EngineConfig(parallelism=self.parallelism).num_partitions
