@@ -14,6 +14,11 @@ from skyline_tpu.ops.block_skyline import (
     skyline_mask_scan,
     skyline_large,
 )
+from skyline_tpu.ops.sfs import (
+    sfs_cleanup,
+    sfs_round,
+    sfs_round_single,
+)
 
 __all__ = [
     "PAD_VALUE",
@@ -26,4 +31,7 @@ __all__ = [
     "skyline_mask_blocked",
     "skyline_mask_scan",
     "skyline_large",
+    "sfs_round",
+    "sfs_round_single",
+    "sfs_cleanup",
 ]

@@ -224,7 +224,7 @@ def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):
         jax.vmap(fn),
         mesh=mesh,
         in_specs=(spec,) * n_in,
-        out_specs=(spec,) * n_out if n_out > 1 else spec,
+        out_specs=(spec,) * n_out,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=donate)

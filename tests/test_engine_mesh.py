@@ -125,3 +125,38 @@ def test_meshed_lazy_sequential_queries(rng):
 
     assert_same_set(r1["skyline_points"], skyline_np(a))
     assert_same_set(r2["skyline_points"], skyline_np(np.concatenate([a, b])))
+
+
+def test_meshed_lazy_capacity_growth_and_checkpoint(rng, tmp_path):
+    """Meshed lazy must survive capacity growth of the sharded buffers
+    mid-flush and a checkpoint/restore onto the same mesh."""
+    from skyline_tpu.ops.dominance import skyline_np
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    n, d = 6000, 3
+    x = np.abs(1500 - rng.uniform(0, 1000, (n, d))).astype(np.float32)
+    cfg = EngineConfig(parallelism=4, algo="mr-dim", dims=d,
+                      domain_max=2000.0, flush_policy="lazy",
+                      emit_skyline_points=True)
+    mesh = make_mesh(8)
+    want = skyline_np(x)
+    ids = np.arange(n)
+
+    eng = SkylineEngine(cfg, mesh=mesh)
+    for i in range(0, n, 1000):
+        eng.process_records(ids[i : i + 1000], x[i : i + 1000])
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    assert r["skyline_size"] == want.shape[0]
+    assert eng.pset._cap > 1024  # growth actually exercised
+
+    eng2 = SkylineEngine(cfg, mesh=mesh)
+    eng2.process_records(ids[:3000], x[:3000])
+    path = str(tmp_path / "meshed_lazy.npz")
+    save_engine(eng2, path)
+    eng3 = load_engine(path, mesh=mesh)
+    eng3.process_records(ids[3000:], x[3000:])
+    eng3.process_trigger("0,0")
+    (r3,) = eng3.poll_results()
+    assert r3["skyline_size"] == want.shape[0]
+    assert_same_set(r3["skyline_points"], want)
