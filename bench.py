@@ -94,9 +94,9 @@ def child_main(backend: str) -> None:
     if backend == "cpu":
         # reduced fallback so a TPU outage still records a real measurement
         # WITHIN the child timeout: the 8-D anti-correlated window is
-        # ~O(N^2) on the CPU scan kernel (measured ~10 min at N=100k), so
-        # size AND window count shrink
-        default_n = int(os.environ.get("BENCH_CPU_N", 32768))
+        # O(N*S) on the CPU SFS path (~15 s at N=131072 after the round-3
+        # lag-2/probe-block work), so size and window count shrink
+        default_n = int(os.environ.get("BENCH_CPU_N", 131072))
         default_windows = 1
     n = int(os.environ.get("BENCH_N", default_n))
     d = int(os.environ.get("BENCH_D", 8))
