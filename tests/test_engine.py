@@ -386,3 +386,16 @@ def test_stats_surface(rng):
     eng.process_trigger("0,0")
     eng.poll_results()
     assert eng.stats()["inflight_queries"] == 0
+
+
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-grid", "mr-angle"])
+def test_single_dimension_window(rng, algo):
+    """d=1 degenerate case: partition ids stay in range for every strategy
+    (mr-angle has zero angle terms at d=1) and the skyline is the minimum."""
+    x = rng.uniform(0, 1000, (500, 1)).astype(np.float32)
+    eng = SkylineEngine(EngineConfig(parallelism=4, algo=algo, dims=1,
+                                     domain_max=1000.0, flush_policy="lazy"))
+    eng.process_records(np.arange(500), x)
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    assert r["skyline_size"] == 1
