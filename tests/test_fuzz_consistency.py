@@ -1,0 +1,66 @@
+"""Randomized cross-configuration consistency: for random streams, chunk
+splits, and interleaved queries, every (flush policy × mesh × partitioner)
+combination must produce the oracle skyline of the records ingested before
+each trigger — the strongest form of the merge-law / device-count-invariance
+properties (SURVEY.md §4), checked jointly instead of per-feature.
+"""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.ops.dominance import skyline_np
+from skyline_tpu.parallel.mesh import make_mesh
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from conftest import assert_same_set
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fuzz_policies_meshes_partitioners(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(800, 3000))
+    d = int(rng.integers(2, 5))
+    dist = rng.choice(["uniform", "anti"])
+    if dist == "uniform":
+        x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    else:
+        base = rng.uniform(0, 1000, (n, 1))
+        x = np.abs(
+            (1000 - base) + rng.normal(0, 60, (n, d))
+        ).astype(np.float32)
+    ids = np.arange(n)
+    # two trigger points inside the stream + one at the end
+    cut1, cut2 = sorted(rng.integers(1, n, size=2).tolist())
+    oracle_1 = skyline_np(x[:cut1])
+    oracle_2 = skyline_np(x[:cut2])
+    oracle_end = skyline_np(x)
+
+    algo = str(rng.choice(["mr-dim", "mr-grid", "mr-angle"]))
+    combos = [
+        ("incremental", None),
+        ("lazy", None),
+        ("incremental", make_mesh(4)),
+        ("lazy", make_mesh(4)),
+    ]
+    for policy, mesh in combos:
+        cfg = EngineConfig(
+            parallelism=4, algo=algo, dims=d, domain_max=1000.0,
+            buffer_size=int(rng.integers(64, 512)),
+            flush_policy=policy, emit_skyline_points=True,
+        )
+        eng = SkylineEngine(cfg, mesh=mesh)
+        pos = 0
+        results = []
+        for stop in (cut1, cut2, n):
+            while pos < stop:
+                step = int(rng.integers(1, 700))
+                end = min(pos + step, stop)
+                eng.process_records(ids[pos:end], x[pos:end])
+                pos = end
+            eng.process_trigger(f"{len(results)},0")
+            results.extend(eng.poll_results())
+        assert len(results) == 3, (policy, mesh, len(results))
+        for r, want in zip(results, (oracle_1, oracle_2, oracle_end)):
+            assert r["skyline_size"] == want.shape[0], (
+                policy, bool(mesh), algo, r["skyline_size"], want.shape[0],
+            )
+            assert_same_set(r["skyline_points"], want)
