@@ -85,23 +85,44 @@ class SkylineWorker:
             self.stats_server.close()
 
     def step(self, max_records: int = 65536) -> int:
-        """One poll cycle: drain data, drain triggers, emit finished results.
+        """One poll cycle: snapshot triggers, ingest data, then apply the
+        triggers. Returns the number of messages processed (0 == idle).
 
-        Returns the number of messages processed (0 == idle).
+        Ordering matters: triggers are POLLED before data but APPLIED after
+        it, and when a trigger arrived the data topic is DRAINED (polled
+        until empty) first. A producer acks its data before sending the
+        trigger that refers to it, so a visible trigger implies that data
+        is committed at the broker; draining ingests all of it — including
+        bursts larger than ``max_records`` — before the trigger runs. The
+        reverse order (data first) has a race: the data fetch can complete
+        empty just before a produce burst while the trigger fetch ~100 ms
+        later sees the burst's trigger, and every still-empty partition
+        then answers the query through the empty-partition fast path (the
+        reference's :351 heuristic) — a premature empty result for a
+        stream that was already produced. The kafkalite fetch is
+        synchronous (an empty poll means no committed data at the offset),
+        so the drain closes the race fully there; transports whose poll
+        can return transiently empty mid-fetch (kafka-python) keep a
+        narrowed version of it.
         """
+        triggers = self._queries.poll(max_records)
         lines = self._data.poll(max_records)
-        if lines:
+        total_lines = 0
+        while lines:
+            total_lines += len(lines)
             ids, values, dropped = parse_tuple_lines(lines, self.engine.config.dims)
             self.engine.dropped += dropped
             self.engine.process_records(ids, values)
-        triggers = self._queries.poll(max_records)
+            if not triggers:
+                break  # no trigger pending: one poll per cycle as before
+            lines = self._data.poll(max_records)
         for t in triggers:
             self.engine.process_trigger(t)
         self.engine.check_timeouts()
         for result in self.engine.poll_results():
             self.bus.produce(self.output_topic, format_result(result))
             self.results_emitted += 1
-        return len(lines) + len(triggers)
+        return total_lines + len(triggers)
 
     def run_forever(self, idle_sleep_s: float = 0.01, stop_after_idle_s: float | None = None):
         """Poll loop; optionally exits after ``stop_after_idle_s`` of silence."""

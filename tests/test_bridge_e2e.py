@@ -80,3 +80,40 @@ def test_query_before_any_data_completes(rng):
     result = json.loads(line)
     assert result["skyline_size"] == 0
     assert result["optimality"] == 0.0
+
+
+def test_worker_step_polls_triggers_before_data_and_applies_after():
+    """The premature-empty-result race (a data fetch completing empty just
+    before a produce burst whose trigger the later trigger-fetch sees)
+    is closed by ordering: triggers are POLLED first and APPLIED after the
+    same cycle's data ingest — a visible trigger implies its
+    produced-before-it data is fetchable. This pins that ordering."""
+    bus = MemoryBus()
+    w = SkylineWorker(bus, EngineConfig(parallelism=2, dims=2,
+                                        domain_max=100.0))
+    events = []
+
+    data_poll = w._data.poll
+    query_poll = w._queries.poll
+    w._data.poll = lambda *a, **k: (events.append("poll:data"),
+                                    data_poll(*a, **k))[1]
+    w._queries.poll = lambda *a, **k: (events.append("poll:queries"),
+                                       query_poll(*a, **k))[1]
+    real_records = w.engine.process_records
+    real_trigger = w.engine.process_trigger
+    w.engine.process_records = lambda *a: (events.append("records"),
+                                           real_records(*a))[1]
+    w.engine.process_trigger = lambda t: (events.append("trigger"),
+                                          real_trigger(t))[1]
+
+    bus.produce_many("input-tuples", ["0,5,5", "1,3,7", "2,9,1"])
+    bus.produce("queries", "0,0")
+    w.step()
+    # with a trigger pending, the data topic is drained (one extra empty
+    # poll) before the trigger is applied
+    assert events == ["poll:queries", "poll:data", "records",
+                      "poll:data", "trigger"], events
+    # step() already drained the result to the output topic
+    out = bus.consumer("output-skyline", from_beginning=True).poll()
+    assert len(out) == 1
+    assert json.loads(out[0])["skyline_size"] == 3  # mutually non-dominated
