@@ -31,7 +31,8 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tuples/s", "vs_baseline": N, ...}
 
 Env knobs: BENCH_N (window size, default 1_000_000), BENCH_D (default 8),
-BENCH_WINDOWS (measured windows, default 3), BENCH_PARALLELISM (default 4),
+BENCH_ALGO (partitioner, default mr-angle), BENCH_WINDOWS (measured windows,
+default 3), BENCH_PARALLELISM (default 4),
 BENCH_BUFFER (flush threshold, default 8192), BENCH_INITIAL_CAP (skyline
 buffer pre-size per partition, default 65536 — lower it on small devices),
 BENCH_COMPILE_CACHE (persistent XLA cache dir, default ./.jax_cache),
@@ -106,9 +107,15 @@ def child_main(backend: str) -> None:
     from skyline_tpu.stream import EngineConfig
     from skyline_tpu.workload.generators import anti_correlated
 
+    # mr-angle is the reference's documented best for anti-correlated data
+    # (pdf §5.6); BENCH_ALGO overrides for partitioner A/B runs — at 8D
+    # mr-angle routes ~96% of rows to 2 of 8 partitions (stream/batched.py
+    # skew notes), so a balanced partitioner can do several times less
+    # local-phase dominance work for the same (invariant) result
+    algo = os.environ.get("BENCH_ALGO", "mr-angle")
     cfg = EngineConfig(
         parallelism=parallelism,
-        algo="mr-angle",  # documented best for anti-correlated (pdf §5.6)
+        algo=algo,
         dims=d,
         domain_max=10000.0,
         buffer_size=int(os.environ.get("BENCH_BUFFER", 8192)),
@@ -178,6 +185,7 @@ def child_main(backend: str) -> None:
                 "window_n": n,
                 "dims": d,
                 "windows_measured": windows,
+                "algo": algo,
                 "skyline_size_p50": int(np.median(sky_sizes)),
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,

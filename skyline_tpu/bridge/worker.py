@@ -33,14 +33,19 @@ class SkylineWorker:
         window_size: int = 0,
         slide: int = 0,
         emit_per_slide: bool = False,
+        max_drain_polls: int = 256,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``stats_port``: serve
         live /stats + /healthz JSON on this port (0 picks a free one; None
         disables) — the Flink-Web-UI role for this stack. ``window_size`` +
         ``slide`` (both > 0) switch the worker to the sliding-window engine
-        (``stream.sliding_engine``), same transport and result planes."""
+        (``stream.sliding_engine``), same transport and result planes.
+        ``max_drain_polls``: cap on trigger-pending data re-polls per step
+        (see ``step``); at the 65536-row default poll size the default cap
+        drains up to ~16.7M rows before a trigger is applied anyway."""
         self.bus = bus
+        self.max_drain_polls = max_drain_polls
         if window_size:
             from skyline_tpu.stream.sliding_engine import SlidingEngine
 
@@ -104,10 +109,21 @@ class SkylineWorker:
         so the drain closes the race fully there; transports whose poll
         can return transiently empty mid-fetch (kafka-python) keep a
         narrowed version of it.
+
+        The drain is BOUNDED at ``max_drain_polls`` re-polls: against a
+        producer that sustains the stream indefinitely, an until-empty
+        drain would starve the trigger, ``check_timeouts()``, and result
+        emission forever. Hitting the bound applies the trigger against
+        everything ingested so far — partitions that have data defer via
+        the id-barrier until their required ids arrive, so the residual
+        exposure is only the reference's own empty-partition fast-path
+        heuristic (FlinkSkyline.java:351) for a partition that got nothing
+        in ``max_drain_polls * max_records`` drained rows.
         """
         triggers = self._queries.poll(max_records)
         lines = self._data.poll(max_records)
         total_lines = 0
+        drains = 0
         while lines:
             total_lines += len(lines)
             ids, values, dropped = parse_tuple_lines(lines, self.engine.config.dims)
@@ -115,6 +131,9 @@ class SkylineWorker:
             self.engine.process_records(ids, values)
             if not triggers:
                 break  # no trigger pending: one poll per cycle as before
+            if drains >= self.max_drain_polls:
+                break  # bounded drain: guarantee trigger/timeout progress
+            drains += 1
             lines = self._data.poll(max_records)
         for t in triggers:
             self.engine.process_trigger(t)

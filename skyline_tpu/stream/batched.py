@@ -37,6 +37,7 @@ from skyline_tpu.ops.dispatch import on_tpu
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
     _MIN_CAP,
+    _active_bucket,
     _next_pow2,
     global_merge_stats_device,
     global_points_device,
@@ -292,7 +293,7 @@ class PartitionSet:
                     # structurally.
                     active = min(
                         self._cap,
-                        _next_pow2(max(int(self._count_ub.max()), 1)),
+                        _active_bucket(max(int(self._count_ub.max()), 1)),
                     )
                     self.sky, self.sky_valid, self._count_dev = (
                         merge_step_active(
@@ -349,7 +350,7 @@ class PartitionSet:
                 if need > self._cap:
                     self._grow_cap(_next_pow2(need))
             active = min(
-                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+                self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/device_put"):
                 batch_dev = self._put(batch)
@@ -436,7 +437,7 @@ class PartitionSet:
                             rp[off : off + B], B
                         )
                     active = min(
-                        self._cap, _next_pow2(max(ub_p, 1))
+                        self._cap, _active_bucket(max(ub_p, 1))
                     )
                     with self.tracer.phase("flush/device_put"):
                         block_dev = jnp.asarray(block)
@@ -507,10 +508,10 @@ class PartitionSet:
             counts = self._sfs_vmapped(rows, max_rows)
         if had_old:
             old_active = min(
-                self._cap, _next_pow2(max(int(old_counts.max()), 1))
+                self._cap, _active_bucket(max(int(old_counts.max()), 1))
             )
             active = min(
-                self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+                self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/merge_kernel"):
                 if self.mesh is not None:
@@ -556,7 +557,7 @@ class PartitionSet:
         # count and active are invalid by the mask; union_cap from the
         # SUMMED bounds keeps the pass union-sized under routing skew)
         active = min(
-            self._cap, _next_pow2(max(int(self._count_ub.max()), 1))
+            self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
         )
         union_cap = _next_pow2(max(int(self._count_ub.sum()), 1))
         union, keep, stats = global_merge_stats_device(

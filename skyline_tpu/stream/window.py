@@ -46,6 +46,48 @@ def _next_pow2(n: int) -> int:
     return next_pow2(n, min_cap=_MIN_CAP)
 
 
+# the ladder only engages when its step (p//8) is a whole number of Pallas
+# victim tiles: derived from the kernel's tile constants so a future tile
+# sweep can't silently strand victims past a truncated grid division
+# (dominated_by_pallas computes grid = n // tile with no remainder handling)
+def _ladder_min() -> int:
+    import math
+
+    from skyline_tpu.ops.pallas_dominance import COL_TILE, ROW_TILE
+
+    return 8 * math.lcm(ROW_TILE, COL_TILE)
+
+
+def _active_bucket(n: int) -> int:
+    """Quarter-pow2 ladder for ACTIVE (compute-prefix) buckets:
+    {1, 1.25, 1.5, 1.75} x 2^k. ``active`` sets the dominator-prefix width
+    of every SFS/merge dominance pass, so the power-of-two bucket's average
+    ~1.33x overshoot of the true survivor count is directly wasted pairwise
+    work; the finer ladder cuts the overshoot to ~1.11x for at most 3 extra
+    executables per octave (cached across windows, persistent via the
+    compile cache). Storage capacities stay power-of-two (`_next_pow2`) —
+    only compute prefixes use this ladder.
+
+    The ladder only runs when the pow2 bucket ``p`` is >= ``_ladder_min()``
+    (8 * lcm(ROW_TILE, COL_TILE) = 16384 at the current tiles, so p//8 is
+    a whole number of victim tiles): the Pallas grids divide the victim
+    extent by the column tile with no remainder handling
+    (ops/pallas_dominance.py), and this guard makes every returned value
+    either a power of two (below the guard) or a tile multiple (at or
+    above it). Note the guard is on ``p``, not the returned value —
+    n=9000 returns 10240, a non-pow2 value below 16384 (still a
+    tile-multiple). Returned values are always >= n and <=
+    _next_pow2(n), so callers' capacity invariants are unaffected."""
+    p = _next_pow2(n)
+    if p < _ladder_min():
+        return p
+    step = p // 8
+    for num in (4, 5, 6, 7):
+        if step * num >= n:
+            return step * num
+    return p
+
+
 def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     """One windowed-BNL step: merge a new batch into a running skyline and
     compact survivors into a fresh ``out_cap`` buffer.
@@ -73,8 +115,9 @@ def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
 def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
     """TPU fast path of ``_merge_step_core``: the three dominance passes run
     in the Pallas VMEM-tiled kernel (same mask logic, same transitivity
-    arguments). Requires sky/batch capacities to be tile multiples — the
-    _MIN_CAP floor and power-of-two bucketing guarantee that."""
+    arguments). Requires sky/batch extents to be tile multiples — the
+    _MIN_CAP floor plus pow2 capacities / pow2-or-tile-multiple active
+    prefixes (``_active_bucket``) guarantee that."""
     from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
 
     interp = _pallas_interpret()

@@ -246,3 +246,24 @@ def test_global_merge_stats_matches_host_oracle(rng):
         keep = np.array([any(np.array_equal(r, gr) for gr in glob)
                          for r in loc]) if loc.shape[0] else np.empty(0)
         assert surv[p] == int(keep.sum()) if loc.shape[0] else surv[p] == 0
+
+
+def test_active_bucket_ladder_invariants():
+    """The quarter-pow2 active ladder: always covers n, never exceeds the
+    pow2 bucket, stays pow2 while the pow2 bucket is below 16384 (Pallas
+    column-tile divisibility), and is a 2048-multiple otherwise."""
+    from skyline_tpu.stream.window import _active_bucket, _next_pow2
+
+    for n in [1, 2, 100, 1024, 4097, 16384, 16385, 20480, 20481,
+              57000, 100000, 437252, 500001, 1 << 20]:
+        b = _active_bucket(n)
+        p = _next_pow2(n)
+        assert b >= n
+        assert b <= p
+        if p < 16384:
+            assert b == p
+        else:
+            assert b % 2048 == 0
+    # the ladder actually tightens: a survivor count just over a pow2
+    # boundary lands on the next quarter step, not the next octave
+    assert _active_bucket(262145) == 327680  # 1.25 * 2^18, not 2^19

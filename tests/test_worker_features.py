@@ -118,3 +118,44 @@ def test_watchdog_reachable_from_cli(rng):
     result = json.loads(line)
     assert result["partial"] is True
     assert result["missing_partitions"]
+
+
+def test_trigger_pending_drain_is_bounded(rng):
+    """A producer that keeps the data topic non-empty must not starve a
+    pending trigger: the drain stops at max_drain_polls and the trigger is
+    applied against what was ingested (regression for the unbounded
+    while-lines loop)."""
+    import numpy as np
+
+    class FirehoseBus(MemoryBus):
+        """MemoryBus whose data consumer refills the topic on every poll,
+        emulating a sustained producer outrunning the worker."""
+
+        def consumer(self, topic, from_beginning=True):
+            inner = super().consumer(topic, from_beginning)
+            if topic != "input-tuples":
+                return inner
+            bus, counter = self, [0]
+
+            class Refilling:
+                def poll(self, max_records):
+                    out = inner.poll(max_records)
+                    i = counter[0]
+                    counter[0] += 3
+                    for k in range(3):  # one tuple per message, like P1
+                        bus.produce(
+                            "input-tuples",
+                            f"{i + k},{float(i + k)},{float(i + k)}",
+                        )
+                    return out
+
+            return Refilling()
+
+    bus = FirehoseBus()
+    cfg = EngineConfig(parallelism=2, algo="mr-dim", dims=2, domain_max=1e9)
+    worker = SkylineWorker(bus, cfg, max_drain_polls=5)
+    bus.produce("input-tuples", "0,1.0,2.0")
+    bus.produce("queries", "7,0")
+    worker.step()  # must terminate (bounded) and answer the trigger
+    out = bus.consumer("output-skyline", from_beginning=True).poll(10)
+    assert len(out) == 1 and '"query_id": "7"' in out[0]
