@@ -196,6 +196,11 @@ class KafkaLiteConsumer:
         self._conn = _Connection(bootstrap, client_id)
         self._reset = auto_offset_reset
         self._offset: int | None = None
+        # decoded-but-undelivered records: a fetch response can carry far
+        # more than one poll's max_records (16 MB of 2-D tuples is ~600k
+        # lines); without this buffer every poll would re-fetch and
+        # re-decode the same blob just to deliver its next 64k slice
+        self._pending: list[str] = []
         self.fetch_max_bytes = fetch_max_bytes
         # Metadata request auto-creates the topic on the embedded broker,
         # matching the reference's auto-create reliance
@@ -242,6 +247,10 @@ class KafkaLiteConsumer:
     def poll(
         self, max_records: int = 65536, timeout_ms: int = 100
     ) -> list[str]:
+        if self._pending:
+            out = self._pending[:max_records]
+            del self._pending[:max_records]
+            return out
         offset = self._position()
         body = (
             P.Writer()
@@ -278,15 +287,20 @@ class KafkaLiteConsumer:
                 if err == P.ERR_OFFSET_OUT_OF_RANGE:
                     # log truncated/reset under us: re-resolve and retry next poll
                     self._offset = None
+                    self._pending.clear()
                     continue
                 if err != P.ERR_NONE:
                     raise KafkaLiteError(f"fetch error {err}")
+                # decode the WHOLE blob once: records past max_records go to
+                # the pending buffer (served by later polls), not back to the
+                # broker for a redundant re-fetch + re-decode
                 for abs_off, _key, value in P.decode_record_batches(
                     blob, verify_crc=self.check_crcs
                 ):
-                    if abs_off < offset or len(out) >= max_records:
+                    if abs_off < offset:
                         continue
-                    out.append((value or b"").decode("utf-8"))
+                    target = out if len(out) < max_records else self._pending
+                    target.append((value or b"").decode("utf-8"))
                     self._offset = abs_off + 1
         return out
 

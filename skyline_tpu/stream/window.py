@@ -50,6 +50,7 @@ def _next_pow2(n: int) -> int:
 # victim tiles: derived from the kernel's tile constants so a future tile
 # sweep can't silently strand victims past a truncated grid division
 # (dominated_by_pallas computes grid = n // tile with no remainder handling)
+@functools.cache
 def _ladder_min() -> int:
     import math
 
@@ -81,8 +82,10 @@ def _active_bucket(n: int) -> int:
     p = _next_pow2(n)
     if p < _ladder_min():
         return p
+    # p is the true next pow2 here (the guard keeps n above the _MIN_CAP
+    # floor), so p/2 < n and the 1.0x(p/2) rung can never be selected
     step = p // 8
-    for num in (4, 5, 6, 7):
+    for num in (5, 6, 7):
         if step * num >= n:
             return step * num
     return p
