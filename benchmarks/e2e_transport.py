@@ -93,9 +93,13 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
         produce_s = None
         deadline = time.time() + timeout_s
         while time.time() < deadline:
+            # a crashed/hung-killed process means no result will ever
+            # come — fail the config now, not at the full timeout (a
+            # producer crash is reported here too, with its log path)
+            crashed = stack.poll_crashed()
+            if crashed:
+                raise RuntimeError(crashed)
             if produce_s is None and producer.poll() is not None:
-                if producer.returncode != 0:
-                    raise RuntimeError("producer failed")
                 produce_s = time.perf_counter() - t0
             if os.path.isfile(csv_path):
                 with open(csv_path) as f:

@@ -58,6 +58,8 @@ class Stack:
         return p
 
     def poll_crashed(self) -> str | None:
+        """Non-zero exit of any supervised process (clean rc=0 exits —
+        e.g. a finished producer — are not crashes)."""
         for name, p in self.procs:
             rc = p.poll()
             if rc is not None and rc != 0:

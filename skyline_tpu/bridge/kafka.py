@@ -56,8 +56,12 @@ class KafkaBus:
         self._producer.flush()
 
     def produce_many(self, topic: str, messages) -> None:
-        for m in messages:
-            self._producer.send(topic, m)
+        send_many = getattr(self._producer, "send_many", None)
+        if send_many is not None:
+            send_many(topic, messages)
+        else:  # pragma: no cover - kafka-python path, not in the baked image
+            for m in messages:
+                self._producer.send(topic, m)
         self._producer.flush()
 
     def consumer(self, topic: str, from_beginning: bool = True):

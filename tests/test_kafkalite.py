@@ -187,3 +187,36 @@ def test_api_versions_negotiation(broker):
               r.array(lambda rr: (rr.int16(), rr.int16(), rr.int16()))}
     assert ranges[P.API_PRODUCE][1] >= 3 and ranges[P.API_FETCH][1] >= 4
     conn.close()
+
+
+def test_send_many_multi_slice_preserves_every_record(broker):
+    """produce_many above linger_records exercises send_many's slice/flush
+    loop (the hot path of the benchmark streams): every record must arrive
+    exactly once, in order, and oversized records must be rejected before
+    any buffering."""
+    from skyline_tpu.bridge.kafka import KafkaBus
+    from skyline_tpu.bridge.kafkalite.client import (
+        KafkaLiteConsumer,
+        MessageSizeTooLargeError,
+    )
+
+    bus = KafkaBus(broker.address)
+    n = 10_000  # > linger_records=4096: at least three slices
+    msgs = [f"{i},{i}.5" for i in range(n)]
+    bus.produce_many("slices", msgs)
+    cons = KafkaLiteConsumer("slices", broker.address)
+    got = []
+    while len(got) < n:
+        batch = cons.poll(4096)
+        if not batch:
+            break
+        got.extend(batch)
+    assert got == msgs
+
+    import pytest
+
+    with pytest.raises(MessageSizeTooLargeError):
+        bus._producer.send_many("slices", ["x" * (11 * 1024 * 1024)])
+    # the rejected call buffered nothing: a flush ships no new records
+    bus._producer.flush()
+    assert cons.poll(10) == []
