@@ -50,6 +50,9 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
             env={"JAX_PLATFORMS": "cpu"},
         )
         wait_for_broker(bootstrap)
+        # workers share the checkout-local compile cache via
+        # default_cache_dir(); SKYLINE_COMPILE_CACHE overrides it if the
+        # operator relocated the cache
         worker_env = {"JAX_PLATFORMS": "cpu"} if cpu else None
         stack.start(
             "worker",
@@ -86,7 +89,7 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
             ["-m", "skyline_tpu.workload.producer", "input-tuples",
              "anti-correlated", str(dims), "0", "10000", "queries",
              "--count", str(records), "--seed", "0",
-             "--query-threshold", str(int(records * 0.95)),
+             "--query-threshold", "0", "--final-trigger",
              "--bootstrap", bootstrap],
             env={"JAX_PLATFORMS": "cpu"},
         )

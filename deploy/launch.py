@@ -194,9 +194,11 @@ def main(argv=None) -> int:
                  "input-tuples", args.distribution, str(args.dims),
                  "0", str(int(args.domain)), "queries",
                  "--count", str(n), "--seed", "0",
-                 # one trigger at ~95% of the stream so every partition's
-                 # id barrier clears (SURVEY.md §3.3 heuristic barrier)
-                 "--query-threshold", str(int(n * 0.95)),
+                 # immediate trigger after the finite stream: an id-barrier
+                 # trigger can defer forever when a sparse partition's few
+                 # records all predate the barrier id (SURVEY.md §3.3 —
+                 # the reference's own producer is an infinite loop)
+                 "--query-threshold", "0", "--final-trigger",
                  "--bootstrap", args.bootstrap],
                 env={"JAX_PLATFORMS": "cpu"},
             )

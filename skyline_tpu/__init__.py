@@ -47,15 +47,17 @@ def _honor_jax_platforms_env() -> None:
     re-apply the env var (exactly what stock JAX would have done).
     """
     import os
+    import sys
 
     want = os.environ.get("JAX_PLATFORMS")
     if not want:
         return
-    try:
-        import jax
-    except ImportError:
-        # no jax at all (a transport-only role, e.g. the kafkalite broker
-        # CLI on a harness host): nothing to repair, nothing to warn about
+    # only repair when a plugin ALREADY imported jax at interpreter startup
+    # (that's the pinning scenario); if jax isn't loaded, its own lazy init
+    # honors the env var natively — and transport-only CLIs (producer,
+    # broker, collector) skip the ~2 s jax import entirely
+    jax = sys.modules.get("jax")
+    if jax is None:
         return
     try:
         import jax._src.xla_bridge as _xb

@@ -38,7 +38,8 @@ def default_cache_dir() -> str:
 
 def enable_compile_cache(cache_dir: str | None = None) -> str:
     """Point JAX's persistent compilation cache at ``cache_dir`` (default
-    ``default_cache_dir()``). Safe to call more than once. Returns the dir."""
+    ``default_cache_dir()``, which honors ``SKYLINE_COMPILE_CACHE``). Safe
+    to call more than once. Returns the dir."""
     import jax
 
     d = cache_dir or default_cache_dir()

@@ -68,6 +68,15 @@ def main(argv=None):
                          "0, unified_producer.py:160, breaking barrier "
                          "monotonicity on resume)")
     ap.add_argument("--start-query-id", type=int, default=0)
+    ap.add_argument("--final-trigger", action="store_true",
+                    help="after a finite stream (--count > 0), send one "
+                         "IMMEDIATE trigger (P3 parity: count-less payload "
+                         "-> required=0, query_trigger.py:21-26). The "
+                         "id-barrier form ('qid,N') can defer forever on a "
+                         "finite stream when a sparse partition's few "
+                         "records all predate N (the reference's heuristic "
+                         "barrier, SURVEY.md §3.3 — its own producer is an "
+                         "infinite loop, so it never faces stream end)")
     args = ap.parse_args(argv)
 
     send = _build_sink(args)
@@ -111,6 +120,10 @@ def main(argv=None):
         if record_id >= next_progress:
             print(f"produced {record_id} records", file=sys.stderr)
             next_progress += 100_000
+    if args.final_trigger and args.count > 0:
+        # data is acked before this produce, so the worker's trigger-pending
+        # drain ingests the whole stream before the immediate query runs
+        send(args.query_topic, [str(query_id)])
     return 0
 
 
