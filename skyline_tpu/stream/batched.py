@@ -400,16 +400,29 @@ class PartitionSet:
                 )
             )
 
-        # worst case (nothing pruned) plus one block write of headroom
-        need = int((counts_host + row_counts).max())
+        # capacity grows ON DEMAND as survivor counts actually grow (one
+        # exact count sync per doubling, like the vmapped path) — the old
+        # worst-case pre-grow (prior counts + ALL streamed rows) allocated
+        # a 16M-row bucket for a 10M-row skewed stream, and executables at
+        # that shape are what crashed the remote-compile helper on the QoS
+        # config. Start with room for existing survivors + one big block.
         B_max = _seq_block(int(row_counts.max()))
-        if need + B_max > self._cap:
-            self._grow_cap(_next_pow2(need + B_max))
+        need0 = int(counts_host.max()) + B_max
+        if need0 > self._cap:
+            self._grow_cap(_next_pow2(need0))
+
+        def _pad_rows(s, new_cap: int):
+            add = jnp.full(
+                (new_cap - s.shape[0], self.dims), jnp.inf, dtype=jnp.float32
+            )
+            return jnp.concatenate([s, add], axis=0)
+
         new_skies = []
         new_counts = []
         for p in range(self.num_partitions):
             rp = rows[p]
             sky_p = self.sky[p]
+            cap_p = sky_p.shape[0]
             cnt_p = self._count_dev[p]
             ub_p = int(counts_host[p])
             if rp.shape[0]:
@@ -432,12 +445,23 @@ class PartitionSet:
                         # half a block (uniform keeps ~1% and never trips)
                         if B < B_big and int(c2) > B // 2:
                             B = B_big
+                    if ub_p + B > cap_p:
+                        # tighten with one exact count sync (a blocking
+                        # read of the previous round), then grow with a
+                        # full block of slack past the trip band — growing
+                        # to exactly ub+B would leave cap in a band this
+                        # check re-enters every round, paying a pipeline
+                        # stall per round instead of one per doubling
+                        ub_p = min(ub_p, int(cnt_p))
+                        if ub_p + 2 * B > cap_p:
+                            cap_p = _next_pow2(ub_p + 2 * B)
+                            sky_p = _pad_rows(sky_p, cap_p)
                     with self.tracer.phase("flush/assemble"):
                         block, bvalid, w = self._pad_block(
                             rp[off : off + B], B
                         )
                     active = min(
-                        self._cap, _active_bucket(max(ub_p, 1))
+                        cap_p, _active_bucket(max(ub_p, 1))
                     )
                     with self.tracer.phase("flush/device_put"):
                         block_dev = jnp.asarray(block)
@@ -449,13 +473,20 @@ class PartitionSet:
                         if self.tracer.sync_device:
                             np.asarray(cnt_p)
                     prev.append((cnt_p, w))
-                    ub_p = min(self._cap, ub_p + w)
+                    ub_p = min(cap_p, ub_p + w)
                     off += w
             new_skies.append(sky_p)
             new_counts.append(cnt_p)
             self._count_ub[p] = ub_p
-        # one stacked reassembly (device-side; no host transfer)
+        # one stacked reassembly (device-side; no host transfer), padded to
+        # the largest per-partition capacity reached
+        final_cap = max(s.shape[0] for s in new_skies)
+        new_skies = [
+            s if s.shape[0] == final_cap else _pad_rows(s, final_cap)
+            for s in new_skies
+        ]
         self.sky = jnp.stack(new_skies)
+        self._cap = final_cap
         counts = jnp.stack(new_counts).astype(jnp.int32)
         self._count_dev = counts
         return counts

@@ -267,3 +267,28 @@ def test_active_bucket_ladder_invariants():
     # the ladder actually tightens: a survivor count just over a pow2
     # boundary lands on the next quarter step, not the next octave
     assert _active_bucket(262145) == 327680  # 1.25 * 2^18, not 2^19
+
+
+def test_sequential_sfs_capacity_tracks_survivors_not_rows(rng):
+    """The skew-path SFS must size its buffers by actual survivor counts,
+    not worst-case streamed rows: a 400k-row skewed stream whose skyline is
+    tiny stays in a small capacity bucket (the worst-case pre-grow put a
+    10M-row QoS stream into a 16M-row bucket, whose executables crashed
+    the remote-compile helper)."""
+    ps = PartitionSet(num_partitions=4, dims=3, buffer_size=8192,
+                      flush_policy="lazy")
+    n = 400_000
+    # heavy skew: ~97% of rows to partition 0; uniform data -> tiny skyline
+    x = rng.uniform(100, 10000, size=(n, 3)).astype(np.float32)
+    ps.add_batch(0, x[: int(n * 0.97)], max_id=0, now_ms=0.0)
+    for p in (1, 2, 3):
+        ps.add_batch(p, x[int(n * 0.97) + (p - 1) * 4000:
+                          int(n * 0.97) + p * 4000], max_id=p, now_ms=0.0)
+    ps.flush_all()
+    counts = ps.sky_counts()
+    assert int(counts.sum()) < 4096  # uniform data: small local skylines
+    # capacity stayed near the survivor scale, nowhere near pow2(rows)
+    assert ps._cap <= 65536 * 2, ps._cap
+    # and the result is still exact
+    local0 = np.asarray(ps.sky[0])[: int(counts[0])]
+    assert_same_set(local0, skyline_np(x[: int(n * 0.97)]))
