@@ -15,6 +15,17 @@ Python unroll (d is tiny: 2-16).
 Grid is (col_tiles, row_tiles): all row tiles for one column tile run
 consecutively, accumulating the per-column "dominated" flags in the output
 block across the inner grid dimension (the standard Pallas reduce pattern).
+
+Considered and rejected (measured, round 3): an int32 rank-compressed
+variant — 2 VPU ops/dim (sub+max) with strictness via exact integer
+rank-sums instead of the min cascade, ~1.3x fewer ops/pair. Scaling runs
+(d=2/4/8/16 at N=262144: 193/261/395/640 ms) show the per-dim cascade is
+~65% of kernel time at d=8, so the variant's ceiling is ~1.2x end-to-end —
+but dense per-dim rank compression costs 2.9 s of host numpy per 1M x 8
+window (vs ~1.5 s of device time saved), and pushing ranking to the device
+would send 32 MB of int32 ranks back through a ~35 MB/s link for host-side
+block assembly. Net negative on this pipeline; revisit only if routing ever
+moves fully on-device.
 """
 
 from __future__ import annotations
