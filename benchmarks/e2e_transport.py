@@ -13,6 +13,13 @@ native/fastcsv -> engine) -> collector (CSV) — and reports:
 
 Prints one JSON line per config and writes ``artifacts/e2e_transport.json``.
 
+Policy choice (measured, round 3, 8-D/1M warm): lazy 22.0 s wall / 12.2 s
+query latency vs incremental (buffer 262144) 61.0 s / 37.3 s — overlapping
+merges with the transport-bound ingest does not pay at high skyline
+fractions: each incremental flush re-prunes against the ~400k-row running
+skylines, tripling total dominance work. The runner therefore pins
+``--flush-policy lazy``.
+
 Usage:
   python benchmarks/e2e_transport.py [--records 1000000] [--dims 2 8]
       [--cpu] [--out artifacts/e2e_transport.json]
