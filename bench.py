@@ -32,7 +32,7 @@ Prints ONE JSON line:
 
 Env knobs: BENCH_N (window size, default 1_000_000), BENCH_D (default 8),
 BENCH_ALGO (partitioner, default mr-angle), BENCH_WINDOWS (measured windows,
-default 3), BENCH_PARALLELISM (default 4),
+default 5), BENCH_PARALLELISM (default 4),
 BENCH_BUFFER (flush threshold, default 8192), BENCH_INITIAL_CAP (skyline
 buffer pre-size per partition, default 65536 — lower it on small devices),
 BENCH_COMPILE_CACHE (persistent XLA cache dir, default ./.jax_cache),
@@ -91,7 +91,10 @@ def child_main(backend: str) -> None:
     enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
 
     default_n = 1_000_000
-    default_windows = 3
+    # 5 measured windows: the remote-TPU link occasionally stalls a
+    # dispatch for seconds; a 5-sample p50 stays clean with up to two
+    # stalled windows, where 3 samples tolerate only one
+    default_windows = 5
     if backend == "cpu":
         # reduced fallback so a TPU outage still records a real measurement
         # WITHIN the child timeout: the 8-D anti-correlated window is
