@@ -308,9 +308,13 @@ class KafkaLiteConsumer:
         for _name, prs in responses or []:
             for _part, err, hw, blob in prs or []:
                 if err == P.ERR_OFFSET_OUT_OF_RANGE:
-                    # log truncated/reset under us: re-resolve and retry next poll
+                    # log truncated/reset under us: re-resolve and retry
+                    # next poll. _pending is structurally empty here (poll
+                    # early-returns while it holds records, so a fetch —
+                    # the only place OOR appears — never runs with content);
+                    # already-decoded records were served before the reset
+                    # was observable, the normal at-least-once behavior.
                     self._offset = None
-                    self._pending.clear()
                     continue
                 if err != P.ERR_NONE:
                     raise KafkaLiteError(f"fetch error {err}")

@@ -220,3 +220,28 @@ def test_send_many_multi_slice_preserves_every_record(broker):
     # the rejected call buffered nothing: a flush ships no new records
     bus._producer.flush()
     assert cons.poll(10) == []
+
+
+def test_pending_buffer_and_offset_reset_semantics(broker):
+    """Offset-reset (log truncated under the consumer) semantics with the
+    pending buffer: records already decoded are served BEFORE the reset can
+    be observed (poll early-returns on a non-empty buffer, so a fetch — the
+    only place OFFSET_OUT_OF_RANGE appears — never runs with content); the
+    reset then re-resolves and replays from earliest. Normal at-least-once
+    behavior, same as kafka-python."""
+    prod = KafkaLiteProducer(broker.address)
+    for i in range(10):
+        prod.send("oor", f"old-{i}")
+    prod.flush()
+    cons = KafkaLiteConsumer("oor", broker.address)
+    assert cons.poll(3) == ["old-0", "old-1", "old-2"]
+    assert len(cons._pending) == 7  # rest of the blob buffered
+    cons._offset = 10_000  # simulate: position now past the high watermark
+    # buffered records surface first — the poisoned offset is not consulted
+    assert cons.poll(4) == [f"old-{i}" for i in range(3, 7)]
+    assert cons.poll(4) == [f"old-{i}" for i in range(7, 10)]
+    # buffer empty: this poll hits OOR, resets, returns nothing yet
+    assert cons.poll(10) == []
+    assert cons._pending == []
+    assert cons._offset is None  # re-resolve on next poll
+    assert cons.poll(100) == [f"old-{i}" for i in range(10)]  # replay
