@@ -403,3 +403,22 @@ def test_single_dimension_window(rng, algo):
     # record) out of range would still report size 1 at d=1
     assert r["skyline_size"] == 1
     assert float(np.asarray(r["skyline_points"]).min()) == float(x.min())
+
+
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-angle"])
+def test_high_dimension_window_matches_oracle(rng, algo):
+    """d=16 (the Pallas kernels' documented unroll ceiling) through the
+    full engine: routing, lazy SFS flush, global merge — exact vs oracle.
+    No other test goes above d=8, so this pins the top of the range."""
+    n, d = 4000, 16
+    x = rng.uniform(0, 1000, size=(n, d)).astype(np.float32)
+    cfg = EngineConfig(parallelism=4, algo=algo, dims=d,
+                       domain_max=1000.0, flush_policy="lazy",
+                       emit_skyline_points=True)
+    eng = SkylineEngine(cfg)
+    _feed(eng, x)
+    eng.process_trigger("0,0")
+    (r,) = eng.poll_results()
+    want = skyline_np(x)
+    assert r["skyline_size"] == want.shape[0]
+    assert_same_set(r["skyline_points"], want)
