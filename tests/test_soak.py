@@ -1,0 +1,98 @@
+"""Opt-in randomized soak suites (SKYLINE_SOAK=1 to enable; skipped by
+default to keep the CI suite fast). Condensed from the round-3 soak runs
+that passed at larger seed counts: engine cross-config fuzz x70, sliding
+vs oracle x40, transport framing x50."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SKYLINE_SOAK", "") != "1",
+    reason="soak suites are opt-in: set SKYLINE_SOAK=1",
+)
+
+
+@pytest.mark.parametrize("seed", range(10, 22))
+def test_soak_engine_cross_config(seed):
+    from test_fuzz_consistency import test_fuzz_policies_meshes_partitioners
+
+    test_fuzz_policies_meshes_partitioners(seed)
+
+
+@pytest.mark.parametrize("seed", range(100, 112))
+def test_soak_sliding_vs_oracle(seed):
+    from skyline_tpu.ops import skyline_np
+    from skyline_tpu.stream.sliding import SlidingSkyline
+
+    rng = np.random.default_rng(seed)
+    d = int(rng.integers(2, 6))
+    window = int(rng.integers(2, 9)) * 50
+    slide = 50
+    n = int(rng.integers(6, 20)) * 50
+    kind = rng.choice(["uniform", "anti", "dup"])
+    if kind == "uniform":
+        x = rng.uniform(0, 1000, size=(n, d)).astype(np.float32)
+    elif kind == "anti":
+        base = rng.uniform(0, 1000, (n, 1))
+        x = np.abs((1000 - base) + rng.normal(0, 50, (n, d))).astype(
+            np.float32
+        )
+    else:  # heavy ties/duplicates
+        x = rng.uniform(0, 10, size=(n, d)).round().astype(np.float32)
+    s = SlidingSkyline(window, slide, d)
+    results = []
+    for i in range(0, n, 70):  # ragged batches crossing slide edges
+        results.extend(s.push(x[i : i + 70]))
+    assert len(results) == n // slide
+    for r in results:
+        end = r["window_end"]
+        lo = max(0, end + 1 - window)
+        expect = skyline_np(x[lo : end + 1])
+        got = np.asarray(r["skyline"], dtype=np.float64)
+        assert got.shape[0] == expect.shape[0], (seed, end)
+        gs = sorted(map(tuple, got.round(5).tolist()))
+        es = sorted(map(tuple, expect.round(5).tolist()))
+        assert gs == es, (seed, end)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_soak_transport_framing(seed):
+    from skyline_tpu.bridge.kafkalite.broker import Broker
+    from skyline_tpu.bridge.kafkalite.client import (
+        KafkaLiteConsumer,
+        KafkaLiteProducer,
+    )
+
+    rng = np.random.default_rng(seed)
+    with Broker() as b:
+        prod = KafkaLiteProducer(
+            b.address, linger_records=int(rng.integers(1, 5000))
+        )
+        n = int(rng.integers(1, 20000))
+        msgs = [
+            f"{i}," + "x" * int(rng.choice([0, 1, 7, 40, 400, 4000]))
+            for i in range(n)
+        ]
+        j = 0
+        while j < n:
+            if rng.random() < 0.5:
+                prod.send("t", msgs[j])
+                j += 1
+            else:
+                k = int(rng.integers(1, 9000))
+                prod.send_many("t", msgs[j : j + k])
+                j += k
+            if rng.random() < 0.2:
+                prod.flush()
+        prod.flush()
+        cons = KafkaLiteConsumer(
+            "t", b.address, check_crcs=bool(rng.random() < 0.5)
+        )
+        got, idle = [], 0
+        while len(got) < n and idle < 50:
+            batch = cons.poll(int(rng.integers(1, 20000)))
+            idle = 0 if batch else idle + 1
+            got.extend(batch)
+        assert got == msgs, (seed, len(got), n)
