@@ -40,6 +40,15 @@ BENCH_PROBE_TIMEOUT (s, default 150), BENCH_PROBE_ATTEMPTS (default 2),
 BENCH_PROBE_BACKOFF (s, default 20), BENCH_CHILD_TIMEOUT (s, default 2400),
 BENCH_TPU_ATTEMPTS (default 2), BENCH_CPU_N (CPU-fallback window size,
 default 131072), BENCH_FORCE_CPU=1 (skip the TPU path entirely).
+
+Defaults are measured-best (round-3 A/Bs on hardware, p50 at the north-star
+window, same link conditions): BENCH_ALGO mr-dim ties mr-angle (6.97 s vs
+7.03 s); mr-angle kept for parity with the reference's documented best for
+anti-correlated data. BENCH_BUFFER 8192 (131072: 7.9 s — block self-prune
+work grows faster than round count shrinks). BENCH_INITIAL_CAP 65536
+(524288: 8.5 s — bigger buffers + fresh executable shapes). flush_policy
+lazy (incremental at buffer 262144: ~3x the dominance work; measured in
+benchmarks/e2e_transport.py's docstring).
 """
 
 from __future__ import annotations
