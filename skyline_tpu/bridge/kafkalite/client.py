@@ -218,6 +218,12 @@ class KafkaLiteConsumer:
         self.check_crcs = check_crcs
         self._conn = _Connection(bootstrap, client_id)
         self._reset = auto_offset_reset
+        # _offset is the FETCH position (next offset to request from the
+        # broker), not the consumed position: it advances past records that
+        # were decoded into _pending but not yet delivered to the caller.
+        # Anything offset-visible to users (position(), a future commit or
+        # seek) must go through the delivered position, which backs out the
+        # undelivered pending records.
         self._offset: int | None = None
         # decoded-but-undelivered records: a fetch response can carry far
         # more than one poll's max_records (16 MB of 2-D tuples is ~600k
@@ -266,6 +272,13 @@ class KafkaLiteConsumer:
                     offset = off
             self._offset = offset
         return self._offset
+
+    def position(self) -> int:
+        """The consumer-visible position: the offset of the next record the
+        CALLER will receive — the fetch position minus the decoded-but-
+        undelivered pending records. This (not ``_offset``) is the value an
+        offset commit or position report must use."""
+        return self._position() - len(self._pending)
 
     def poll(
         self, max_records: int = 65536, timeout_ms: int = 100

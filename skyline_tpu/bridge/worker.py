@@ -9,6 +9,7 @@ and produces one JSON result per completed query on the output topic
 
 from __future__ import annotations
 
+import sys
 import time
 
 from skyline_tpu.bridge.wire import format_result, parse_tuple_lines
@@ -71,8 +72,6 @@ class SkylineWorker:
             except OSError as e:
                 # observability is optional: a port conflict must not take
                 # the worker (and with it the whole deploy stack) down
-                import sys
-
                 print(
                     f"skyline worker: stats port {stats_port} unavailable "
                     f"({e}); continuing without /stats",
@@ -132,7 +131,21 @@ class SkylineWorker:
             if not triggers:
                 break  # no trigger pending: one poll per cycle as before
             if drains >= self.max_drain_polls:
-                break  # bounded drain: guarantee trigger/timeout progress
+                # bounded drain: guarantee trigger/timeout progress. With an
+                # immediate (required=0) trigger pending this means the query
+                # answers against a TRUNCATED ingest — say so loudly, and
+                # point at the knob (--max-drain-polls) that raises the bound
+                print(
+                    f"skyline worker: drain bound hit after {drains + 1} polls "
+                    f"({total_lines} rows) with {len(triggers)} trigger(s) "
+                    "pending — the stream may exceed "
+                    "max_drain_polls * max_records; queries with an id "
+                    "barrier defer safely, but an immediate (required=0) "
+                    "trigger will answer against the rows drained so far. "
+                    "Raise --max-drain-polls for larger finite streams.",
+                    file=sys.stderr,
+                )
+                break
             drains += 1
             lines = self._data.poll(max_records)
         for t in triggers:
@@ -162,8 +175,6 @@ class SkylineWorker:
 def main(argv=None):
     """CLI: run the worker against a Kafka broker with reference-style flags
     (the `flink run` equivalent of README_Ubuntu_Setup.md's job launch)."""
-    import sys
-
     from skyline_tpu.bridge.kafka import KafkaBus
     from skyline_tpu.utils.compile_cache import enable_compile_cache
     from skyline_tpu.utils.config import parse_job_args
@@ -184,6 +195,7 @@ def main(argv=None):
         window_size=cfg.window_size,
         slide=cfg.slide,
         emit_per_slide=cfg.emit_per_slide,
+        max_drain_polls=cfg.max_drain_polls,
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "

@@ -46,6 +46,10 @@ class JobConfig:
     window_size: int = 0
     slide: int = 0
     emit_per_slide: bool = False
+    # cap on trigger-pending data re-polls per worker step; raise for
+    # finite streams larger than max_drain_polls * poll size (~16.7M rows
+    # at the defaults) so immediate triggers see the full ingest
+    max_drain_polls: int = 256
 
     def __post_init__(self):
         if self.parallelism < 1:
@@ -72,6 +76,10 @@ class JobConfig:
             )
         if self.mesh < 0:
             raise ValueError(f"mesh must be >= 0, got {self.mesh}")
+        if self.max_drain_polls < 1:
+            raise ValueError(
+                f"max_drain_polls must be >= 1, got {self.max_drain_polls}"
+            )
         # the over-partitioning factor is owned by EngineConfig; validate
         # against it rather than a duplicated literal
         num_partitions = EngineConfig(parallelism=self.parallelism).num_partitions
@@ -188,6 +196,12 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=_env_bool("EMIT_PER_SLIDE"),
                     help="emit one result JSON per completed slide in "
                          "addition to trigger-driven results")
+    ap.add_argument("--max-drain-polls", type=int,
+                    default=_env_int("MAX_DRAIN_POLLS",
+                                     defaults.max_drain_polls),
+                    help="cap on trigger-pending data re-polls per step; "
+                         "raise for finite streams larger than "
+                         "max_drain_polls * 65536 rows")
     a = ap.parse_args(argv)
     return JobConfig(
         parallelism=a.parallelism,
@@ -209,6 +223,7 @@ def parse_job_args(argv=None) -> JobConfig:
         window_size=a.window_size,
         slide=a.slide,
         emit_per_slide=a.emit_per_slide,
+        max_drain_polls=a.max_drain_polls,
     )
 
 

@@ -245,3 +245,23 @@ def test_pending_buffer_and_offset_reset_semantics(broker):
     assert cons._pending == []
     assert cons._offset is None  # re-resolve on next poll
     assert cons.poll(100) == [f"old-{i}" for i in range(10)]  # replay
+
+
+def test_consumer_position_excludes_pending(broker):
+    """position() reports the DELIVERED offset: records decoded into the
+    pending buffer but not yet served must not count (the fetch position
+    ``_offset`` runs ahead of the caller by design)."""
+    prod = KafkaLiteProducer(broker.address)
+    for i in range(10):
+        prod.send("pos", str(i))
+    prod.flush()
+    cons = KafkaLiteConsumer("pos", broker.address)
+    got = cons.poll(max_records=3)  # fetch decodes all 10, delivers 3
+    assert got == ["0", "1", "2"]
+    assert cons.position() == 3
+    assert cons._offset == 10  # fetch position ran ahead
+    got = cons.poll(max_records=7)
+    assert got == [str(i) for i in range(3, 10)]
+    assert cons.position() == 10
+    prod.close()
+    cons.close()

@@ -159,3 +159,37 @@ def test_trigger_pending_drain_is_bounded(rng):
     worker.step()  # must terminate (bounded) and answer the trigger
     out = bus.consumer("output-skyline", from_beginning=True).poll(10)
     assert len(out) == 1 and '"query_id": "7"' in out[0]
+
+
+def test_drain_bound_warns_with_trigger_pending(rng, capsys):
+    """Hitting the drain bound while a trigger is pending warns on stderr
+    (an immediate trigger then answers against a truncated ingest)."""
+    class Endless:
+        def __init__(self):
+            self.i = 0
+
+        def poll(self, max_records):
+            i, self.i = self.i, self.i + 1
+            return [f"{i},{float(i)},{float(i)}"]
+
+    class EndlessBus(MemoryBus):
+        def consumer(self, topic, from_beginning=True):
+            if topic == "input-tuples":
+                return Endless()
+            return super().consumer(topic, from_beginning)
+
+    bus = EndlessBus()
+    cfg = EngineConfig(parallelism=1, algo="mr-dim", dims=2, domain_max=1e9)
+    worker = SkylineWorker(bus, cfg, max_drain_polls=3)
+    bus.produce("queries", "9,0")
+    worker.step()
+    err = capsys.readouterr().err
+    assert "drain bound hit" in err
+    assert "--max-drain-polls" in err
+
+
+def test_max_drain_polls_cli_flag():
+    cfg = parse_job_args(["--max-drain-polls", "7"])
+    assert cfg.max_drain_polls == 7
+    with pytest.raises(ValueError):
+        JobConfig(max_drain_polls=0)
