@@ -1,28 +1,28 @@
-"""Opt-in randomized soak suites (SKYLINE_SOAK=1 to enable; skipped by
-default to keep the CI suite fast). Condensed from the round-3 soak runs
-that passed at larger seed counts: engine cross-config fuzz x70, sliding
-vs oracle x40, transport framing x50."""
+"""Randomized invariant suites, two tiers per scenario:
+
+- bounded tier (default): small streams, a handful of seeds — the same
+  invariants (engine cross-config consistency, sliding-vs-oracle, transport
+  framing) run on every plain ``pytest`` within ~1 min total.
+- soak tier (``SKYLINE_SOAK=1``): the full-size randomized versions,
+  condensed from the round-3 soak runs that passed at larger seed counts:
+  engine cross-config fuzz x70, sliding vs oracle x40, transport framing x50.
+"""
 
 import os
 
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.skipif(
+soak = pytest.mark.skipif(
     os.environ.get("SKYLINE_SOAK", "") != "1",
-    reason="soak suites are opt-in: set SKYLINE_SOAK=1",
+    reason="full-size soak tier is opt-in: set SKYLINE_SOAK=1",
 )
 
 
-@pytest.mark.parametrize("seed", range(10, 22))
-def test_soak_engine_cross_config(seed):
-    from test_fuzz_consistency import test_fuzz_policies_meshes_partitioners
-
-    test_fuzz_policies_meshes_partitioners(seed)
+# -- scenario bodies (size-parameterized; shared by both tiers) -------------
 
 
-@pytest.mark.parametrize("seed", range(100, 112))
-def test_soak_sliding_vs_oracle(seed):
+def _sliding_vs_oracle(seed: int, n_scale: int) -> None:
     from skyline_tpu.ops import skyline_np
     from skyline_tpu.stream.sliding import SlidingSkyline
 
@@ -30,7 +30,7 @@ def test_soak_sliding_vs_oracle(seed):
     d = int(rng.integers(2, 6))
     window = int(rng.integers(2, 9)) * 50
     slide = 50
-    n = int(rng.integers(6, 20)) * 50
+    n = int(rng.integers(6, 6 + n_scale)) * 50
     kind = rng.choice(["uniform", "anti", "dup"])
     if kind == "uniform":
         x = rng.uniform(0, 1000, size=(n, d)).astype(np.float32)
@@ -57,8 +57,7 @@ def test_soak_sliding_vs_oracle(seed):
         assert gs == es, (seed, end)
 
 
-@pytest.mark.parametrize("seed", range(12))
-def test_soak_transport_framing(seed):
+def _transport_framing(seed: int, max_records: int) -> None:
     from skyline_tpu.bridge.kafkalite.broker import Broker
     from skyline_tpu.bridge.kafkalite.client import (
         KafkaLiteConsumer,
@@ -70,7 +69,7 @@ def test_soak_transport_framing(seed):
         prod = KafkaLiteProducer(
             b.address, linger_records=int(rng.integers(1, 5000))
         )
-        n = int(rng.integers(1, 20000))
+        n = int(rng.integers(1, max_records))
         msgs = [
             f"{i}," + "x" * int(rng.choice([0, 1, 7, 40, 400, 4000]))
             for i in range(n)
@@ -96,3 +95,46 @@ def test_soak_transport_framing(seed):
             idle = 0 if batch else idle + 1
             got.extend(batch)
         assert got == msgs, (seed, len(got), n)
+
+
+# -- bounded tier: runs on every default pytest -----------------------------
+
+
+@pytest.mark.parametrize("seed", range(10, 13))
+def test_engine_cross_config_bounded(seed):
+    from test_fuzz_consistency import run_fuzz_scenario
+
+    run_fuzz_scenario(seed, max_n=900, min_n=300)
+
+
+@pytest.mark.parametrize("seed", range(100, 104))
+def test_sliding_vs_oracle_bounded(seed):
+    _sliding_vs_oracle(seed, n_scale=4)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_transport_framing_bounded(seed):
+    _transport_framing(seed, max_records=4000)
+
+
+# -- soak tier: SKYLINE_SOAK=1 ----------------------------------------------
+
+
+@soak
+@pytest.mark.parametrize("seed", range(10, 22))
+def test_soak_engine_cross_config(seed):
+    from test_fuzz_consistency import run_fuzz_scenario
+
+    run_fuzz_scenario(seed)
+
+
+@soak
+@pytest.mark.parametrize("seed", range(100, 112))
+def test_soak_sliding_vs_oracle(seed):
+    _sliding_vs_oracle(seed, n_scale=14)
+
+
+@soak
+@pytest.mark.parametrize("seed", range(12))
+def test_soak_transport_framing(seed):
+    _transport_framing(seed, max_records=20000)
