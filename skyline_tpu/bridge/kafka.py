@@ -39,7 +39,11 @@ class KafkaBus:
         if HAVE_KAFKA:  # pragma: no cover - not in the baked image
             self._producer = _KafkaProducer(
                 bootstrap_servers=bootstrap,
-                value_serializer=lambda s: s.encode("utf-8"),
+                # str OR bytes: the producer CLI's native formatter emits
+                # bytes lines (kafkalite's send accepts both natively)
+                value_serializer=lambda s: (
+                    s if isinstance(s, bytes) else s.encode("utf-8")
+                ),
                 max_request_size=MAX_REQUEST_SIZE,
             )
             self._lite = False

@@ -76,6 +76,17 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.c_int64,
             ]
+        if hasattr(lib, "sky_format_tuples"):
+            lib.sky_format_tuples.restype = ctypes.c_int64
+            lib.sky_format_tuples.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
         _lib = lib
     return _lib
 
@@ -108,6 +119,34 @@ def crc32c_native(data: bytes):
     if lib is None or not hasattr(lib, "sky_crc32c"):
         return None
     return int(lib.sky_crc32c(data, len(data)))
+
+
+def format_tuples_native(ids: np.ndarray, values: np.ndarray):
+    """Format data-plane lines ``"id,v1,...,vd"`` from int64 arrays
+    (ids (n,), values (n, d)) — the produce-plane twin of
+    ``parse_tuples_native``. Returns ``(blob, offsets)`` where record i is
+    ``blob[offsets[i]:offsets[i+1]]``, or None if the library or symbol is
+    unavailable (callers fall back to Python formatting)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sky_format_tuples"):
+        return None
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    n, d = values.shape
+    out = np.empty(n * (d + 1) * 21 + 64, dtype=np.uint8)
+    offsets = np.empty(n + 1, dtype=np.int64)
+    w = lib.sky_format_tuples(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        d,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.shape[0],
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if w < 0:
+        return None
+    return out[:w].tobytes(), offsets
 
 
 def encode_records_native(values: list[bytes]):

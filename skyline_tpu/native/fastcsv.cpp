@@ -242,3 +242,55 @@ extern "C" int64_t sky_encode_records(const uint8_t* values,
     }
     return w;
 }
+
+namespace {
+
+// Reverse-digit int64 -> decimal ascii; returns the advanced write pointer.
+inline char* write_i64(char* w, int64_t v) {
+    if (v < 0) {
+        *w++ = '-';
+        // negate via unsigned to survive INT64_MIN
+        uint64_t u = static_cast<uint64_t>(-(v + 1)) + 1;
+        char tmp[20];
+        int k = 0;
+        do { tmp[k++] = static_cast<char>('0' + u % 10); u /= 10; } while (u);
+        while (k) *w++ = tmp[--k];
+        return w;
+    }
+    uint64_t u = static_cast<uint64_t>(v);
+    char tmp[20];
+    int k = 0;
+    do { tmp[k++] = static_cast<char>('0' + u % 10); u /= 10; } while (u);
+    while (k) *w++ = tmp[--k];
+    return w;
+}
+
+}  // namespace
+
+// Format n data-plane lines "id,v1,...,vd" (no separators between records —
+// `offsets` carries the n+1 prefix offsets, so record i is
+// out[offsets[i]:offsets[i+1]]). The produce-plane twin of sky_parse_tuples:
+// the reference emits integer-valued tuples (unified_producer.py:174) and
+// the Python producer casts to int64 before formatting, so values arrive
+// here already as int64. Returns bytes written, or -1 if out_cap would be
+// exceeded (callers size out at 21 bytes per field).
+extern "C" int64_t sky_format_tuples(const int64_t* ids,
+                                     const int64_t* values, int64_t n,
+                                     int32_t dims, char* out, int64_t out_cap,
+                                     int64_t* offsets) {
+    char* w = out;
+    const char* end = out + out_cap;
+    const int64_t worst = (static_cast<int64_t>(dims) + 1) * 21;
+    for (int64_t i = 0; i < n; ++i) {
+        offsets[i] = w - out;
+        if (end - w < worst) return -1;
+        w = write_i64(w, ids[i]);
+        const int64_t* row = values + i * dims;
+        for (int32_t k = 0; k < dims; ++k) {
+            *w++ = ',';
+            w = write_i64(w, row[k]);
+        }
+    }
+    offsets[n] = w - out;
+    return w - out;
+}

@@ -16,16 +16,17 @@ Grid is (col_tiles, row_tiles): all row tiles for one column tile run
 consecutively, accumulating the per-column "dominated" flags in the output
 block across the inner grid dimension (the standard Pallas reduce pattern).
 
-Considered and rejected (measured, round 3): an int32 rank-compressed
-variant — 2 VPU ops/dim (sub+max) with strictness via exact integer
-rank-sums instead of the min cascade, ~1.3x fewer ops/pair. Scaling runs
-(d=2/4/8/16 at N=262144: 193/261/395/640 ms) show the per-dim cascade is
-~65% of kernel time at d=8, so the variant's ceiling is ~1.2x end-to-end —
-but dense per-dim rank compression costs 2.9 s of host numpy per 1M x 8
-window (vs ~1.5 s of device time saved), and pushing ranking to the device
-would send 32 MB of int32 ranks back through a ~35 MB/s link for host-side
-block assembly. Net negative on this pipeline; revisit only if routing ever
-moves fully on-device.
+Rank-compressed cascade (round 4; round 3 had rejected it when ranking was
+host-side): ``rank_transform`` computes per-dim DENSE ranks + rank sums on
+device — dense rank over the compared universe is a perfect order
+embedding (v1 < v2 implies rank(v1) < rank(v2) because v1 itself is
+counted; equal values share a rank), and the strictness test collapses to
+ONE precomputed rank-sum compare per pair: ``a dominates b  <=>
+max_k(ra_k - rb_k) <= 0  AND  rsum_a < rsum_b`` (all-<= with equal sums
+forces equality in every dim since each term is <=). That is 2 VPU ops per
+dim + 2 instead of 3 per dim + 2 — see ``_dom_tile_rank`` and the A/B
+artifact ``artifacts/rank_cascade_ab.json`` (benchmarks/rank_cascade.py).
+Rank sums stay exact in f32 (ranks < N <= 2^20, sums < d * N << 2^24).
 """
 
 from __future__ import annotations
@@ -98,6 +99,106 @@ def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
 
     dom = _dom_tile(d, x_ref, y_ref, v_ref)
     out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
+def _dom_tile_rank(d: int, x_ref, y_ref, v_ref):
+    """(R, C) dominance tile over per-dim dense ranks: rows 0..d-1 of the
+    refs are ranks, row d is the rank sum. 2 f32 VPU ops per dimension
+    (sub, max) plus one sum compare — the strict-dimension test the value
+    cascade pays a min-chain for collapses into the precomputed rank sums
+    (see module docstring for the exactness argument)."""
+    diff = x_ref[0, :][:, None] - y_ref[0, :][None, :]
+    mx = diff
+    for k in range(1, d):
+        mx = jnp.maximum(mx, x_ref[k, :][:, None] - y_ref[k, :][None, :])
+    sd = x_ref[d, :][:, None] - y_ref[d, :][None, :]
+    vmask = v_ref[0, :][:, None] > 0.5
+    return (mx <= 0.0) & (sd < 0.0) & vmask
+
+
+def _kernel_rank_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
+    """Triangular rank-cascade kernel: same skip logic as ``_kernel_tri``
+    (inputs sorted ascending by a dominance-monotone key — value sum or
+    rank sum both qualify)."""
+    j, i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(i * rt <= j * ct + (ct - 1))
+    def _compute():
+        dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
+        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
+def _kernel_rank(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
+    out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
+def rank_transform(x: jax.Array, valid: jax.Array):
+    """Per-dim dense ranks + rank sum over one point set (the compared
+    universe) — the device-side preprocessing for the rank cascade.
+
+    x: (N, d); valid: (N,) bool. Invalid rows are ranked as +inf values:
+    every dim gets rank n_valid (= count of finite entries), making them
+    inert exactly like +inf padding in the value cascade (they tie other
+    pads, never strictly dominate). Returns ``rt (d+1, N) float32`` —
+    ranks transposed with the rank-sum as the extra last row, the layout
+    ``dominated_by_any_rank_pallas`` consumes.
+    """
+    xm = jnp.where(valid[:, None], x, jnp.inf)
+    sorted_cols = jnp.sort(xm, axis=0)
+    ranks = jax.vmap(
+        lambda col, sc: jnp.searchsorted(sc, col, side="left"),
+        in_axes=(1, 1),
+        out_axes=1,
+    )(xm, sorted_cols).astype(jnp.float32)
+    rsum = jnp.sum(ranks, axis=1, keepdims=True)
+    return jnp.concatenate([ranks, rsum], axis=1).T
+
+
+@functools.partial(
+    jax.jit, static_argnames=("triangular", "interpret", "row_tile", "col_tile")
+)
+def dominated_by_any_rank_pallas(
+    rt: jax.Array,
+    valid: jax.Array,
+    triangular: bool = False,
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+) -> jax.Array:
+    """Rank-cascade twin of ``dominated_by_any_pallas``: rt is the
+    (d+1, N) output of ``rank_transform`` (per-dim dense ranks + rank-sum
+    row). ``triangular=True`` requires columns sorted ascending by a
+    dominance-monotone key (value sum or rank sum)."""
+    dp1, n = rt.shape
+    d = dp1 - 1
+    r_t, c_t = min(row_tile, n), min(col_tile, n)
+    grid = (n // c_t, n // r_t)
+    v2 = valid[None, :].astype(jnp.float32)
+    kern = _kernel_rank_tri if triangular else _kernel_rank
+    out = pl.pallas_call(
+        functools.partial(kern, d, r_t, c_t),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((dp1, r_t), lambda j, i: (0, i)),
+            pl.BlockSpec((1, r_t), lambda j, i: (0, i)),
+            pl.BlockSpec((dp1, c_t), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, c_t), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.bool_),
+        interpret=interpret,
+    )(rt, v2, rt)
+    return out[0]
 
 
 @functools.partial(
@@ -212,6 +313,50 @@ def skyline_mask_pallas(
     vs = valid[order]
     dominated = dominated_by_any_pallas(
         xs.T,
+        vs,
+        triangular=True,
+        interpret=interpret,
+        row_tile=row_tile,
+        col_tile=col_tile,
+    )
+    keep_sorted = ~dominated & vs
+    return keep_sorted[inv][:n]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+)
+def skyline_mask_rank_pallas(
+    x: jax.Array,
+    valid: jax.Array | None = None,
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+) -> jax.Array:
+    """Rank-cascade twin of ``skyline_mask_pallas``: same pad / sum-sort /
+    triangular / unsort pipeline, with the pairwise pass running over
+    device-computed dense ranks (``rank_transform``) instead of raw values.
+    Self-contained — the compared universe is exactly ``x``'s valid rows,
+    so the rank embedding is exact and the result is identical."""
+    n, d = x.shape
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    tile = max(row_tile, col_tile)
+    padded = -(-n // tile) * tile
+    if padded != n:
+        pad_x = jnp.full((padded - n, d), PAD_VALUE, dtype=x.dtype)
+        x = jnp.concatenate([x, pad_x], axis=0)
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((padded - n,), dtype=bool)], axis=0
+        )
+    keys = jnp.where(valid, jnp.sum(x, axis=-1), jnp.inf)
+    order = jnp.argsort(keys, stable=True)
+    inv = jnp.argsort(order, stable=True)
+    xs = x[order]
+    vs = valid[order]
+    rt = rank_transform(xs, vs)
+    dominated = dominated_by_any_rank_pallas(
+        rt,
         vs,
         triangular=True,
         interpret=interpret,

@@ -51,12 +51,18 @@ from skyline_tpu.stream.window import (
 )
 
 
+from skyline_tpu.stream import device_window as dw
+
 # Sequential-SFS probe block: rounds start at this size so a small-skyline
 # partition never pays big-block dominance work; the loop escalates to the
 # row-scaled block once a round's surviving count exceeds half a block
 # (a probe round keeps at most B survivors, so half-a-block survival is
 # strong evidence of a large skyline).
 _PROBE_B = 8192
+
+# Device-ingest chunks are split/padded to power-of-two buckets capped here,
+# bounding the set of ingest executables.
+_CHUNK_BUCKET_MAX = 65536
 
 
 class PartitionSet:
@@ -80,6 +86,8 @@ class PartitionSet:
         initial_capacity: int = 0,
         tracer=None,
         flush_policy: str = "incremental",
+        route: tuple[str, float] | None = None,
+        overlap_rows: int = 262144,
     ):
         """``initial_capacity``: pre-size the per-partition skyline buffers
         (rounded up to the power-of-two bucket). Capacity normally grows on
@@ -105,15 +113,48 @@ class PartitionSet:
           skew-sequential path and the device-side global merge are
           single-device specializations, so the meshed flush always uses
           the vmapped rounds and the engine's host-side global merge).
+        - ``"overlap"``: the lazy machinery with automatic chunked flushes
+          every ``overlap_rows`` accumulated rows, so the append rounds of
+          an earlier chunk run on device WHILE the host parses / uploads
+          the next one (JAX async dispatch). A mid-window flush on
+          non-empty state pays the old-vs-new SFS cleanup pass per chunk —
+          a fraction of the append work — in exchange for hiding device
+          time behind the transport-bound ingest (the concurrent
+          source/operator dataflow Flink gets by construction,
+          FlinkSkyline.java:84-104). Results identical (merge law).
+
+        ``route``: ``(algo, domain_max)`` enables DEVICE ingest for the
+        lazy/overlap policies: raw chunks are uploaded as they arrive and
+        partition routing, the flush-time (pid, sum) sort, and SFS block
+        slicing all run on device (see stream/device_window.py). ``None``
+        keeps the host routing path (the engine routes and calls
+        ``add_batch``). Single-device only.
         """
         self.num_partitions = num_partitions
         self.dims = dims
         self.buffer_size = buffer_size
         self.initial_capacity = initial_capacity
+        self.overlap_rows = overlap_rows
         self.tracer = tracer if tracer is not None else NULL_TRACER
-        if flush_policy not in ("incremental", "lazy"):
+        if flush_policy not in ("incremental", "lazy", "overlap"):
             raise ValueError(f"unknown flush_policy {flush_policy!r}")
+        if route is not None and (
+            mesh is not None or flush_policy == "incremental"
+        ):
+            raise ValueError(
+                "device ingest (route=...) requires a single-device "
+                "lazy/overlap PartitionSet"
+            )
         self.flush_policy = flush_policy
+        self._route = route
+        # device-ingest accumulation state (route is not None):
+        self._dev_window = None  # (dev_cap, d) +inf-padded row buffer
+        self._dev_pids = None  # (dev_cap,) int32, sentinel num_partitions
+        self._dev_cap = 0
+        self._dev_rows = 0  # valid rows currently accumulated
+        # per-chunk (stats_dev (2, P), now_ms) awaiting a host bookkeeping
+        # sync (lazy: only a query barrier or a flush needs them)
+        self._chunk_stats: list[tuple] = []
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -181,12 +222,135 @@ class PartitionSet:
         self._pending[p].append(values)
         self._pending_rows[p] += n
 
+    @property
+    def device_ingest(self) -> bool:
+        return self._route is not None
+
+    @property
+    def has_unsynced_ingest(self) -> bool:
+        return bool(self._chunk_stats)
+
+    @property
+    def pending_rows_total(self) -> int:
+        """Un-flushed rows across both ingest paths (host pending lists +
+        the device accumulation window)."""
+        return int(self._pending_rows.sum()) + self._dev_rows
+
+    def ingest_chunk(self, ids, values, now_ms: float) -> None:
+        """Device-ingest twin of route-then-``add_batch``: upload one raw
+        micro-batch, compute its partition ids and per-partition barrier
+        stats on device (stream/device_window.py), and append it to the
+        accumulated window. Host-side barrier/metrics bookkeeping is synced
+        lazily (``sync_ingest_bookkeeping``) — the hot no-pending-queries
+        path never waits on the device."""
+        n = values.shape[0]
+        if n == 0:
+            return
+        algo, domain_max = self._route
+        if int(ids.max()) >= 2**31:
+            raise ValueError(
+                "device ingest tracks record ids as int32; ids >= 2^31 "
+                "need the host ingest path"
+            )
+        for s in range(0, n, _CHUNK_BUCKET_MAX):
+            chunk = np.asarray(values[s : s + _CHUNK_BUCKET_MAX], np.float32)
+            cids = ids[s : s + _CHUNK_BUCKET_MAX]
+            m = chunk.shape[0]
+            bucket = _next_pow2(m)
+            vp = np.full((bucket, self.dims), np.inf, dtype=np.float32)
+            vp[:m] = chunk
+            ip = np.full((bucket,), -1, dtype=np.int32)
+            ip[:m] = cids
+            self._ensure_dev_capacity(self._dev_rows + bucket)
+            self._dev_window, self._dev_pids, stats = dw.ingest_chunk(
+                self._dev_window,
+                self._dev_pids,
+                jnp.asarray(vp),
+                jnp.asarray(ip),
+                m,
+                self._dev_rows,
+                algo=algo,
+                num_partitions=self.num_partitions,
+                domain_max=domain_max,
+            )
+            self._dev_rows += m
+            self._chunk_stats.append((stats, now_ms))
+
+    def _ensure_dev_capacity(self, need: int) -> None:
+        """Allocate or double the device accumulation buffers. The write
+        offset is row-granular while ``need`` includes the incoming chunk's
+        padded bucket, so the dynamic_update_slice never clamps."""
+        if self._dev_window is None:
+            cap = max(_next_pow2(need), 131072)
+            self._dev_window = jnp.full(
+                (cap, self.dims), jnp.inf, dtype=jnp.float32
+            )
+            self._dev_pids = jnp.full(
+                (cap,), self.num_partitions, dtype=jnp.int32
+            )
+            self._dev_cap = cap
+            return
+        while self._dev_cap < need:
+            new_cap = self._dev_cap * 2
+            self._dev_window = jnp.concatenate(
+                [
+                    self._dev_window,
+                    jnp.full(
+                        (new_cap - self._dev_cap, self.dims),
+                        jnp.inf,
+                        dtype=jnp.float32,
+                    ),
+                ],
+                axis=0,
+            )
+            self._dev_pids = jnp.concatenate(
+                [
+                    self._dev_pids,
+                    jnp.full(
+                        (new_cap - self._dev_cap,),
+                        self.num_partitions,
+                        dtype=jnp.int32,
+                    ),
+                ]
+            )
+            self._dev_cap = new_cap
+
+    def sync_ingest_bookkeeping(self) -> None:
+        """Fold queued per-chunk device stats into the host barrier/metrics
+        state (max_seen_id, records_seen, start_time_ms). One small
+        transfer per queued chunk; called before any barrier check or
+        flush, never on the pure-ingest hot path."""
+        if not self._chunk_stats:
+            return
+        with self.tracer.phase("ingest/bookkeeping_sync"):
+            for stats_dev, now_ms in self._chunk_stats:
+                s = np.asarray(stats_dev, dtype=np.int64)
+                counts, maxids = s[0], s[1]
+                got = counts > 0
+                self.records_seen[got] += counts[got]
+                np.maximum(
+                    self.max_seen_id,
+                    np.where(got, maxids, -1),
+                    out=self.max_seen_id,
+                )
+                for p in np.nonzero(got)[0]:
+                    if self.start_time_ms[p] is None:
+                        self.start_time_ms[p] = now_ms
+        self._chunk_stats = []
+
     def maybe_flush(self) -> bool:
         """Flush all partitions once the largest pending buffer reaches
         ``buffer_size`` (the processBuffer threshold, FlinkSkyline.java:232,
         applied set-wide). Returns True if a flush happened. Under the lazy
-        policy this never fires — all work happens at query time."""
+        policy this never fires — all work happens at query time. Under the
+        overlap policy it fires whenever ``overlap_rows`` rows have
+        accumulated across both ingest paths."""
         if self.flush_policy == "lazy":
+            return False
+        if self.flush_policy == "overlap":
+            if self.pending_rows_total >= self.overlap_rows:
+                self.flush_all()
+                return True
             return False
         if int(self._pending_rows.max()) >= self.buffer_size:
             self.flush_all()
@@ -239,13 +403,18 @@ class PartitionSet:
     def flush_all(self) -> None:
         """Merge every partition's pending rows into its running skyline:
         one batched device launch per round (incremental policy), or
-        append-only SFS rounds over the sum-sorted pending windows (lazy
-        policy)."""
+        append-only SFS rounds over the sum-sorted pending windows
+        (lazy/overlap policies — host pending lists first, then the device
+        accumulation window; a restored checkpoint can leave host pendings
+        on a device-ingest set)."""
         total = int(self._pending_rows.sum())
-        if total == 0:
+        if self.flush_policy in ("lazy", "overlap"):
+            if total:
+                self._flush_lazy()
+            if self._dev_rows:
+                self._flush_lazy_device()
             return
-        if self.flush_policy == "lazy":
-            self._flush_lazy()
+        if total == 0:
             return
         t0 = time.perf_counter_ns()
         with self.tracer.phase("flush/assemble"):
@@ -374,6 +543,42 @@ class PartitionSet:
         self._count_dev = counts
         return counts
 
+    def _seq_block_size(self, rows_p: int) -> int:
+        """The large-skyline sequential block: a ~500k-row heavy partition
+        runs 8 rounds at B=64k instead of 30 at 16k (the self-prune cost
+        grows only linearly in B, dispatch latency through the tunnel per
+        round is the real price). Only used once the running count has
+        PROVEN large — per-round work is B x bucket(S + B), so big blocks
+        on a small-skyline stream multiply total work for nothing (uniform
+        4D: S ~ 500 of 500k rows)."""
+        return _next_pow2(
+            min(
+                max(rows_p, 1),
+                max(self.buffer_size, 16384, min(rows_p // 8, 65536)),
+            )
+        )
+
+    def _pad_sky_rows(self, s, new_cap: int):
+        add = jnp.full(
+            (new_cap - s.shape[0], self.dims), jnp.inf, dtype=jnp.float32
+        )
+        return jnp.concatenate([s, add], axis=0)
+
+    def _restack_skies(self, new_skies: list, new_counts: list):
+        """One stacked reassembly after a sequential pass (device-side; no
+        host transfer), padded to the largest per-partition capacity
+        reached."""
+        final_cap = max(s.shape[0] for s in new_skies)
+        new_skies = [
+            s if s.shape[0] == final_cap else self._pad_sky_rows(s, final_cap)
+            for s in new_skies
+        ]
+        self.sky = jnp.stack(new_skies)
+        self._cap = final_cap
+        counts = jnp.stack(new_counts).astype(jnp.int32)
+        self._count_dev = counts
+        return counts
+
     def _sfs_sequential(self, rows: list[np.ndarray]):
         """Skew-path SFS: heavy partitions processed one at a time with
         per-partition block and active buckets — total work tracks each
@@ -385,37 +590,16 @@ class PartitionSet:
         counts_host = self.sky_counts().astype(np.int64)
         row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
 
-        def _seq_block(rows_p: int) -> int:
-            # the large-skyline block: a ~500k-row heavy partition runs 8
-            # rounds at B=64k instead of 30 at 16k (the self-prune cost
-            # grows only linearly in B, dispatch latency through the tunnel
-            # per round is the real price). Only used once the running
-            # count has PROVEN large — per-round work is B x bucket(S + B),
-            # so big blocks on a small-skyline stream multiply total work
-            # for nothing (uniform 4D: S ~ 500 of 500k rows).
-            return _next_pow2(
-                min(
-                    max(rows_p, 1),
-                    max(self.buffer_size, 16384, min(rows_p // 8, 65536)),
-                )
-            )
-
         # capacity grows ON DEMAND as survivor counts actually grow (one
         # exact count sync per doubling, like the vmapped path) — the old
         # worst-case pre-grow (prior counts + ALL streamed rows) allocated
         # a 16M-row bucket for a 10M-row skewed stream, and executables at
         # that shape are what crashed the remote-compile helper on the QoS
         # config. Start with room for existing survivors + one big block.
-        B_max = _seq_block(int(row_counts.max()))
+        B_max = self._seq_block_size(int(row_counts.max()))
         need0 = int(counts_host.max()) + B_max
         if need0 > self._cap:
             self._grow_cap(_next_pow2(need0))
-
-        def _pad_rows(s, new_cap: int):
-            add = jnp.full(
-                (new_cap - s.shape[0], self.dims), jnp.inf, dtype=jnp.float32
-            )
-            return jnp.concatenate([s, add], axis=0)
 
         new_skies = []
         new_counts = []
@@ -429,7 +613,7 @@ class PartitionSet:
                 # start at the probe block; escalate to the big block only
                 # once the running count proves the skyline is large (a
                 # known-large prior skyline escalates immediately)
-                B_big = _seq_block(rp.shape[0])
+                B_big = self._seq_block_size(rp.shape[0])
                 B = B_big if ub_p > _PROBE_B // 2 else min(_PROBE_B, B_big)
                 # lag-2 tightening (see _sfs_vmapped): low-skyline heavy
                 # partitions would otherwise pay active buckets that track
@@ -455,7 +639,7 @@ class PartitionSet:
                         ub_p = min(ub_p, int(cnt_p))
                         if ub_p + 2 * B > cap_p:
                             cap_p = _next_pow2(ub_p + 2 * B)
-                            sky_p = _pad_rows(sky_p, cap_p)
+                            sky_p = self._pad_sky_rows(sky_p, cap_p)
                     with self.tracer.phase("flush/assemble"):
                         block, bvalid, w = self._pad_block(
                             rp[off : off + B], B
@@ -478,18 +662,62 @@ class PartitionSet:
             new_skies.append(sky_p)
             new_counts.append(cnt_p)
             self._count_ub[p] = ub_p
-        # one stacked reassembly (device-side; no host transfer), padded to
-        # the largest per-partition capacity reached
-        final_cap = max(s.shape[0] for s in new_skies)
-        new_skies = [
-            s if s.shape[0] == final_cap else _pad_rows(s, final_cap)
-            for s in new_skies
-        ]
-        self.sky = jnp.stack(new_skies)
-        self._cap = final_cap
-        counts = jnp.stack(new_counts).astype(jnp.int32)
-        self._count_dev = counts
-        return counts
+        return self._restack_skies(new_skies, new_counts)
+
+    def _sfs_sequential_dev(self, ws, bounds: np.ndarray):
+        """Device-window twin of ``_sfs_sequential``: blocks are sliced out
+        of the sorted window ``ws`` at host-tracked offsets instead of
+        assembled from host rows — same probe/escalation, lag-2 tightening,
+        and on-demand capacity growth. Returns the device counts vector."""
+        counts_host = self.sky_counts().astype(np.int64)
+        widths = np.diff(bounds)
+        # blocks sliced from the sorted window must fit its SORT_TAIL pad
+        # (a dynamic_slice past the buffer clamps backward and desyncs the
+        # block from its validity mask) — cap every device block there
+        B_max = min(self._seq_block_size(int(widths.max())), dw.SORT_TAIL)
+        need0 = int(counts_host.max()) + B_max
+        if need0 > self._cap:
+            self._grow_cap(_next_pow2(need0))
+
+        new_skies = []
+        new_counts = []
+        for p in range(self.num_partitions):
+            lo, hi = int(bounds[p]), int(bounds[p + 1])
+            sky_p = self.sky[p]
+            cap_p = sky_p.shape[0]
+            cnt_p = self._count_dev[p]
+            ub_p = int(counts_host[p])
+            if hi > lo:
+                B_big = min(self._seq_block_size(hi - lo), dw.SORT_TAIL)
+                B = B_big if ub_p > _PROBE_B // 2 else min(_PROBE_B, B_big)
+                prev: list[tuple] = []
+                off = lo
+                while off < hi:
+                    if len(prev) >= 2:
+                        c2, w1 = prev[-2][0], prev[-1][1]
+                        ub_p = min(ub_p, int(c2) + w1)
+                        if B < B_big and int(c2) > B // 2:
+                            B = B_big
+                    if ub_p + B > cap_p:
+                        ub_p = min(ub_p, int(cnt_p))
+                        if ub_p + 2 * B > cap_p:
+                            cap_p = _next_pow2(ub_p + 2 * B)
+                            sky_p = self._pad_sky_rows(sky_p, cap_p)
+                    w = min(B, hi - off)
+                    active = min(cap_p, _active_bucket(max(ub_p, 1)))
+                    with self.tracer.phase("flush/merge_kernel"):
+                        sky_p, cnt_p = dw.sfs_round_at(
+                            sky_p, cnt_p, ws, off, w, B=B, active=active
+                        )
+                        if self.tracer.sync_device:
+                            np.asarray(cnt_p)
+                    prev.append((cnt_p, w))
+                    ub_p = min(cap_p, ub_p + w)
+                    off += w
+            new_skies.append(sky_p)
+            new_counts.append(cnt_p)
+            self._count_ub[p] = ub_p
+        return self._restack_skies(new_skies, new_counts)
 
     def _grow_cap(self, new_cap: int) -> None:
         """Grow the stacked skyline storage to ``new_cap`` rows (padding
@@ -514,14 +742,7 @@ class PartitionSet:
                 if r.shape[0] > 1:
                     order = np.argsort(r.sum(axis=1), kind="stable")
                     rows[p] = r[order]
-        # non-empty initial state needs exact old counts for the final
-        # old-vs-new cleanup pass (one sync; fresh windows skip it)
-        had_old = bool((self._count_ub > 0).any())
-        old_counts = (
-            self.sky_counts().astype(np.int32) if had_old else None
-        )
-        if had_old and not int(old_counts.max()):
-            had_old = False
+        had_old, old_counts = self._check_had_old()
 
         max_rows = max(r.shape[0] for r in rows)
         total_rows = int(sum(r.shape[0] for r in rows))
@@ -537,6 +758,20 @@ class PartitionSet:
             counts = self._sfs_sequential(rows)
         else:
             counts = self._sfs_vmapped(rows, max_rows)
+        self._finish_lazy_flush(counts, had_old, old_counts, t0)
+
+    def _check_had_old(self):
+        """Non-empty initial state needs exact old counts for the final
+        old-vs-new cleanup pass (one sync; fresh windows skip it)."""
+        had_old = bool((self._count_ub > 0).any())
+        old_counts = self.sky_counts().astype(np.int32) if had_old else None
+        if had_old and not int(old_counts.max()):
+            had_old = False
+        return had_old, old_counts
+
+    def _finish_lazy_flush(self, counts, had_old, old_counts, t0) -> None:
+        """Shared tail of the lazy flush paths: old-vs-new cleanup,
+        validity/caches, one bound-tightening sync."""
         if had_old:
             old_active = min(
                 self._cap, _active_bucket(max(int(old_counts.max()), 1))
@@ -571,6 +806,86 @@ class PartitionSet:
         # double its pairwise work for nothing
         self.sky_counts()
         self.processing_ns += time.perf_counter_ns() - t0
+
+    def _flush_lazy_device(self) -> None:
+        """Lazy/overlap flush over the device accumulation window: one
+        (pid, sum) sort + segment-bounds launch, then SFS rounds slicing
+        blocks straight from the sorted buffer (stream/device_window.py) —
+        no host routing, assembly, or per-block upload."""
+        t0 = time.perf_counter_ns()
+        self.sync_ingest_bookkeeping()
+        n = self._dev_rows
+        n_bucket = _next_pow2(n)
+        with self.tracer.phase("flush/sort"):
+            ws, bounds_dev = dw.sort_window(
+                self._dev_window,
+                self._dev_pids,
+                n,
+                n_bucket,
+                self.num_partitions,
+                dw.SORT_TAIL,
+            )
+            bounds = np.asarray(bounds_dev, dtype=np.int64)
+        self._dev_rows = 0
+        had_old, old_counts = self._check_had_old()
+        widths = np.diff(bounds)
+        max_rows = int(widths.max())
+        total_rows = int(widths.sum())
+        # same skew heuristic as the host path (see _flush_lazy)
+        if self.num_partitions * max_rows > 2 * total_rows:
+            counts = self._sfs_sequential_dev(ws, bounds)
+        else:
+            counts = self._sfs_vmapped_dev(ws, bounds, max_rows)
+        self._finish_lazy_flush(counts, had_old, old_counts, t0)
+
+    def _sfs_vmapped_dev(self, ws, bounds: np.ndarray, max_rows: int):
+        """Device-window twin of ``_sfs_vmapped``: one vmapped launch per
+        round, every lane slicing its block from the shared sorted window.
+        Returns the device counts vector."""
+        # cap at SORT_TAIL: see _sfs_sequential_dev's B_max note
+        B = min(
+            _next_pow2(min(max_rows, max(self.buffer_size, 8192))),
+            dw.SORT_TAIL,
+        )
+        n_rounds = -(-max_rows // B)
+        counts = self._count_dev
+        lo = bounds[:-1]
+        hi = bounds[1:]
+        prev: list[tuple] = []  # lag-2 tightening, see _sfs_vmapped
+        for rnd in range(n_rounds):
+            offs = np.minimum(lo + rnd * B, hi)
+            w = np.clip(hi - offs, 0, B)
+            if len(prev) >= 2:
+                c2, w1 = prev[-2][0], prev[-1][1]
+                self._count_ub = np.minimum(
+                    self._count_ub,
+                    np.asarray(c2, dtype=np.int64) + w1,
+                )
+            need = int(self._count_ub.max()) + B
+            if need > self._cap:
+                self._count_ub = np.asarray(counts, dtype=np.int64)
+                need = int(self._count_ub.max()) + B
+                if need > self._cap:
+                    self._grow_cap(_next_pow2(need))
+            active = min(
+                self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
+            )
+            with self.tracer.phase("flush/merge_kernel"):
+                self.sky, counts = dw.sfs_round_at_vmapped(
+                    self.sky,
+                    counts,
+                    ws,
+                    jnp.asarray(offs.astype(np.int32)),
+                    jnp.asarray(w.astype(np.int32)),
+                    B=B,
+                    active=active,
+                )
+                if self.tracer.sync_device:
+                    np.asarray(counts)
+            prev.append((counts, w))
+            self._count_ub = np.minimum(self._cap, self._count_ub + w)
+        self._count_dev = counts
+        return counts
 
     # -- query ------------------------------------------------------------
 
@@ -660,6 +975,10 @@ class PartitionSet:
         values, as ``utils.checkpoint.load_engine`` does).
         """
         assert len(skies) == len(pendings) == self.num_partitions
+        # discard any un-flushed device-ingest window (checkpoint saves
+        # flush it first, so a restore over live state starts clean)
+        self._dev_rows = 0
+        self._chunk_stats = []
         self.max_seen_id[:] = -1
         self.start_time_ms = [None] * self.num_partitions
         self.records_seen[:] = 0

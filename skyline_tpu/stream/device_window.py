@@ -1,0 +1,163 @@
+"""Device-resident ingest: routing, window assembly, and SFS block slicing
+run on the accelerator instead of host numpy.
+
+The host ingest path (engine ``process_records`` + ``PartitionSet`` pending
+lists) computes partition ids, routes rows, sum-sorts and pads blocks in
+numpy, then uploads each padded block — ~1.2 s of host work per 1M-row
+window through the profiling breakdown (BENCH_r03). This module is the
+keyBy-inside-the-dataflow equivalent (the reference keeps its shuffle inside
+the Flink job graph, FlinkSkyline.java:138): raw chunks upload once as they
+arrive (overlapping parse and transport), partition ids / per-chunk barrier
+stats are computed on device, the flush-time (pid, coordinate-sum) sort and
+segment bounds are one device launch, and the SFS rounds read their blocks
+directly out of the sorted device window via ``dynamic_slice`` — no host
+assembly and no per-block ``device_put``.
+
+Owner: ``stream.batched.PartitionSet`` (``ingest="device"``). All kernels
+here are stateless jits; static shapes come from power-of-two chunk/window
+buckets so executables are bounded and cached.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skyline_tpu.ops.dispatch import on_tpu
+from skyline_tpu.ops.sfs import pallas_interpret, sfs_round_core
+from skyline_tpu.parallel.partitioners import partition_ids
+
+# Padding tail appended to the sorted window so a B-row dynamic_slice
+# starting at any valid row offset never clamps backward (dynamic_slice
+# shifts the start when the slice would run past the end — which would
+# desynchronize the block from its validity mask). Must be >= the largest
+# SFS block size used by the flush loops.
+SORT_TAIL = 65536
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("algo", "num_partitions", "domain_max"),
+    donate_argnums=(0, 1),
+)
+def ingest_chunk(
+    window,
+    pidbuf,
+    chunk,
+    ids,
+    nvalid,
+    offset,
+    *,
+    algo: str,
+    num_partitions: int,
+    domain_max: float,
+):
+    """Append one uploaded chunk to the device window and route it.
+
+    window: (cap, d) +inf-padded accumulation buffer (donated — updated in
+    place); pidbuf: (cap,) int32, ``num_partitions`` sentinel for invalid
+    rows (donated); chunk: (B, d) +inf-padded rows; ids: (B,) int32 record
+    ids (-1 padding); nvalid/offset: dynamic scalars.
+
+    Returns (window', pidbuf', stats (2, P)) where stats rows are the
+    per-partition [row counts, max record ids] of THIS chunk — the engine's
+    barrier bookkeeping (max-seen-id per partition, FlinkSkyline.java:276-283)
+    synced lazily on the host only when a query needs it.
+    """
+    B = chunk.shape[0]
+    valid = jnp.arange(B) < nvalid
+    pids = partition_ids(chunk, algo, num_partitions, domain_max)
+    pids = jnp.where(valid, pids, num_partitions).astype(jnp.int32)
+    window = lax.dynamic_update_slice(
+        window, chunk, (offset, jnp.zeros((), jnp.int32))
+    )
+    pidbuf = lax.dynamic_update_slice(pidbuf, pids, (offset,))
+    counts = jax.ops.segment_sum(
+        valid.astype(jnp.int32), pids, num_segments=num_partitions + 1
+    )[:num_partitions]
+    maxids = jax.ops.segment_max(
+        jnp.where(valid, ids, -1), pids, num_segments=num_partitions + 1
+    )[:num_partitions]
+    return window, pidbuf, jnp.stack([counts, maxids])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_bucket", "num_partitions", "tail")
+)
+def sort_window(
+    window, pidbuf, nvalid, n_bucket: int, num_partitions: int, tail: int
+):
+    """Flush-time shuffle: order the accumulated window by (partition id,
+    coordinate sum) and return per-partition segment bounds.
+
+    Within each partition the rows come out in ascending coordinate-sum
+    order — exactly the SFS append-only invariant (ops/sfs.py), so the
+    flush loops can stream contiguous blocks straight from this buffer.
+    Two stable argsorts compose the two-key order (int64 keys are
+    unavailable without x64). Rows at or past ``nvalid`` are forced to the
+    sentinel pid — the accumulation buffer is reused across windows, so
+    rows beyond the current fill may hold stale pids from a previous,
+    larger window. Invalid rows sort last; ``bounds[P]`` equals ``nvalid``.
+
+    Returns (sorted (n_bucket + tail, d) with a +inf tail pad — see
+    SORT_TAIL — and bounds (P + 1,) int32).
+    """
+    d = window.shape[1]
+    w = lax.slice(window, (0, 0), (n_bucket, d))
+    p = lax.slice(pidbuf, (0,), (n_bucket,))
+    p = jnp.where(jnp.arange(n_bucket) < nvalid, p, num_partitions)
+    sums = jnp.where(p < num_partitions, jnp.sum(w, axis=1), jnp.inf)
+    o1 = jnp.argsort(sums, stable=True)
+    o2 = jnp.argsort(p[o1], stable=True)
+    order = o1[o2]
+    ws = jnp.concatenate(
+        [w[order], jnp.full((tail, d), jnp.inf, dtype=w.dtype)], axis=0
+    )
+    bounds = jnp.searchsorted(
+        p[order], jnp.arange(num_partitions + 1, dtype=p.dtype)
+    ).astype(jnp.int32)
+    return ws, bounds
+
+
+@functools.partial(
+    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+)
+def sfs_round_at(sky_p, count, win, off, width, *, B: int, active: int):
+    """One partition's SFS round reading its block out of the sorted device
+    window: block = win[off : off + B], valid rows = first ``width``.
+    The tail rows of a partition's final block belong to the NEXT partition
+    in the sorted order — masked to +inf so they are inert as dominators
+    and never appended. Drop-in device-window twin of
+    ``ops.sfs.sfs_round_single``."""
+    d = win.shape[1]
+    block = lax.dynamic_slice(win, (off, jnp.zeros((), jnp.int32)), (B, d))
+    bvalid = jnp.arange(B) < width
+    block = jnp.where(bvalid[:, None], block, jnp.inf)
+    return sfs_round_core(
+        sky_p, count, block, bvalid, active, on_tpu(), pallas_interpret()
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+)
+def sfs_round_at_vmapped(sky, counts, win, offs, widths, *, B: int, active: int):
+    """Vmapped ``sfs_round_at`` over all partitions (sky (P, cap, d),
+    offs/widths (P,)) — one launch per round for balanced loads, each lane
+    slicing its own block from the shared sorted window."""
+    use_pallas = on_tpu()
+    interp = pallas_interpret()
+    d = win.shape[1]
+
+    def core(s, c, off, width):
+        block = lax.dynamic_slice(
+            win, (off, jnp.zeros((), jnp.int32)), (B, d)
+        )
+        bvalid = jnp.arange(B) < width
+        block = jnp.where(bvalid[:, None], block, jnp.inf)
+        return sfs_round_core(s, c, block, bvalid, active, use_pallas, interp)
+
+    return jax.vmap(core)(sky, counts, offs, widths)

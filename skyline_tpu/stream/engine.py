@@ -64,9 +64,19 @@ class EngineConfig:
     # "incremental": merge pending rows at the buffer_size cadence (the
     # reference's processBuffer model); "lazy": accumulate and compute at
     # query time via append-only SFS rounds — far less total work for
-    # tumbling-window-then-query streams (see stream/batched.py). Identical
-    # results either way; under a mesh the lazy rounds run shard_map SPMD.
+    # tumbling-window-then-query streams (see stream/batched.py); "overlap":
+    # the lazy machinery flushed every ``overlap_rows`` so device append
+    # rounds run concurrently with transport/parse of the next chunk (the
+    # Flink-style source/operator overlap). Identical results all three
+    # ways; under a mesh the lazy rounds run shard_map SPMD.
     flush_policy: str = "incremental"
+    # rows accumulated between automatic flushes under flush_policy="overlap"
+    overlap_rows: int = 262144
+    # "auto": route + sort + SFS block slicing on device when single-device
+    # lazy/overlap without grid_prefilter (stream/device_window.py); "host":
+    # numpy routing in process_records; "device": force the device path
+    # (errors if unsupported by the configuration)
+    ingest: str = "auto"
 
     @property
     def num_partitions(self) -> int:
@@ -137,6 +147,30 @@ class SkylineEngine:
         self.config = config
         self.mesh = mesh
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # resolve the ingest path: device ingest moves routing/sort/block
+        # slicing onto the accelerator (stream/device_window.py); it
+        # requires single-device lazy/overlap and no grid prefilter (the
+        # prefilter inspects raw values host-side)
+        device_ok = (
+            mesh is None
+            and config.flush_policy in ("lazy", "overlap")
+            and not config.grid_prefilter
+        )
+        if config.ingest == "device":
+            if not device_ok:
+                raise ValueError(
+                    "ingest='device' requires single-device lazy/overlap "
+                    "without grid_prefilter"
+                )
+            use_device = True
+        elif config.ingest == "auto":
+            from skyline_tpu.ops.dispatch import on_tpu
+
+            use_device = device_ok and on_tpu()
+        elif config.ingest == "host":
+            use_device = False
+        else:
+            raise ValueError(f"unknown ingest mode {config.ingest!r}")
         # stacked device state: all partitions' skylines merge in ONE launch
         # per flush (see stream/batched.py); `partitions` are per-partition
         # facades over it
@@ -148,6 +182,8 @@ class SkylineEngine:
             initial_capacity=config.initial_capacity,
             tracer=self.tracer,
             flush_policy=config.flush_policy,
+            route=(config.algo, config.domain_max) if use_device else None,
+            overlap_rows=config.overlap_rows,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
@@ -177,6 +213,17 @@ class SkylineEngine:
             now_ms = time.time() * 1000.0
         cfg = self.config
         self.records_in += values.shape[0]
+        if self.pset.device_ingest:
+            # routing + barrier stats on device; host bookkeeping syncs only
+            # when a pending query needs its barrier re-evaluated
+            with self.tracer.phase("ingest/devroute"):
+                self.pset.ingest_chunk(ids, values, now_ms)
+            if any(self._pending_queries.values()):
+                self.pset.sync_ingest_bookkeeping()
+                for p in range(cfg.num_partitions):
+                    now_ms = self._recheck_pending(p, now_ms)
+            self.pset.maybe_flush()
+            return
         with self.tracer.phase("partition_ids"):
             pids = partition_ids_np(
                 values, cfg.algo, cfg.num_partitions, cfg.domain_max
@@ -248,6 +295,9 @@ class SkylineEngine:
         host; the full local-skyline buffers are never transferred."""
         if now_ms is None:
             now_ms = time.time() * 1000.0
+        if self.pset.has_unsynced_ingest:
+            # barrier checks below read per-partition max ids
+            self.pset.sync_ingest_bookkeeping()
         qid, required = parse_trigger(payload)
         q = _QueryState(qid=qid, payload=payload, required=required, dispatch_ms=now_ms)
         self._inflight[payload] = q
@@ -495,12 +545,14 @@ class SkylineEngine:
         ``include_skyline_counts=True`` adds exact per-partition skyline
         sizes at the cost of one device sync; leave False on hot paths.
         """
+        if self.pset.has_unsynced_ingest:
+            self.pset.sync_ingest_bookkeeping()
         out = {
             "records_in": self.records_in,
             "dropped": self.dropped,
             "prefiltered": self.prefiltered,
             "inflight_queries": len(self._inflight),
-            "pending_flush_rows": int(self.pset._pending_rows.sum()),
+            "pending_flush_rows": self.pset.pending_rows_total,
             "processing_ms": self.pset.processing_ms,
             "partitions": {
                 "records_seen": self.pset.records_seen.tolist(),

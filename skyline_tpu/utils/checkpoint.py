@@ -23,6 +23,13 @@ _FORMAT_VERSION = 1
 def save_engine(engine: SkylineEngine, path: str) -> None:
     """Serialize engine state to ``path`` (.npz, single file)."""
     cfg = engine.config
+    if engine.pset.device_ingest:
+        # un-flushed rows live in the device accumulation window, which has
+        # no host pending representation; folding them into the skylines
+        # first is result-equivalent (the merge law) and makes the
+        # checkpoint self-contained
+        engine.pset.sync_ingest_bookkeeping()
+        engine.pset.flush_all()
     arrays: dict[str, np.ndarray] = {}
     meta = {
         "version": _FORMAT_VERSION,
@@ -40,6 +47,8 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
             "grid_prefilter": cfg.grid_prefilter,
             "initial_capacity": cfg.initial_capacity,
             "flush_policy": cfg.flush_policy,
+            "overlap_rows": cfg.overlap_rows,
+            "ingest": cfg.ingest,
         },
         "records_in": engine.records_in,
         "dropped": engine.dropped,

@@ -38,6 +38,8 @@ class JobConfig:
     grid_prefilter: bool = False
     initial_capacity: int = 0
     flush_policy: str = "incremental"
+    overlap_rows: int = 262144  # flush cadence under flush_policy=overlap
+    ingest: str = "auto"  # auto|host|device (see EngineConfig.ingest)
     # worker runtime knobs
     mesh: int = 0  # >0: shard partitions over this many devices
     stats_port: int = 0  # >0: serve /stats + /healthz on this port
@@ -70,9 +72,18 @@ class JobConfig:
             raise ValueError(
                 f"initial_capacity must be >= 0, got {self.initial_capacity}"
             )
-        if self.flush_policy not in ("incremental", "lazy"):
+        if self.flush_policy not in ("incremental", "lazy", "overlap"):
             raise ValueError(
-                f"flush_policy must be incremental|lazy, got {self.flush_policy!r}"
+                "flush_policy must be incremental|lazy|overlap, "
+                f"got {self.flush_policy!r}"
+            )
+        if self.overlap_rows < 1:
+            raise ValueError(
+                f"overlap_rows must be >= 1, got {self.overlap_rows}"
+            )
+        if self.ingest not in ("auto", "host", "device"):
+            raise ValueError(
+                f"ingest must be auto|host|device, got {self.ingest!r}"
             )
         if self.mesh < 0:
             raise ValueError(f"mesh must be >= 0, got {self.mesh}")
@@ -99,14 +110,15 @@ class JobConfig:
             )
         if self.window_size and (
             self.grid_prefilter
-            or self.flush_policy == "lazy"
+            or self.flush_policy in ("lazy", "overlap")
             or self.initial_capacity
         ):
             # the sliding engine implements none of these; failing beats
             # an operator believing a filter is active when it is not
             raise ValueError(
                 "sliding-window mode (--window/--slide) does not support "
-                "--grid-prefilter, --flush-policy lazy, or --initial-capacity"
+                "--grid-prefilter, --flush-policy lazy/overlap, or "
+                "--initial-capacity"
             )
 
     def engine_config(self) -> EngineConfig:
@@ -121,6 +133,8 @@ class JobConfig:
             grid_prefilter=self.grid_prefilter,
             initial_capacity=self.initial_capacity,
             flush_policy=self.flush_policy,
+            overlap_rows=self.overlap_rows,
+            ingest=self.ingest,
         )
 
     def build_mesh(self):
@@ -176,9 +190,20 @@ def parse_job_args(argv=None) -> JobConfig:
     ap.add_argument("--initial-capacity", type=int,
                     default=_env_int("INITIAL_CAPACITY", defaults.initial_capacity),
                     help="pre-size per-partition skyline buffers")
-    ap.add_argument("--flush-policy", choices=("incremental", "lazy"),
+    ap.add_argument("--flush-policy",
+                    choices=("incremental", "lazy", "overlap"),
                     default=os.environ.get("SKYLINE_FLUSH_POLICY",
                                            defaults.flush_policy))
+    ap.add_argument("--overlap-rows", type=int,
+                    default=_env_int("OVERLAP_ROWS", defaults.overlap_rows),
+                    help="rows between automatic flushes under "
+                         "--flush-policy overlap (device work then overlaps "
+                         "transport/parse of the next chunk)")
+    ap.add_argument("--ingest", choices=("auto", "host", "device"),
+                    default=os.environ.get("SKYLINE_INGEST", defaults.ingest),
+                    help="where routing/sort/block assembly runs: auto "
+                         "picks device on a single accelerator under "
+                         "lazy/overlap")
     ap.add_argument("--mesh", type=int, default=_env_int("MESH", defaults.mesh),
                     help="shard the partition state over this many devices "
                          "(0 = single device)")
@@ -218,6 +243,8 @@ def parse_job_args(argv=None) -> JobConfig:
         grid_prefilter=a.grid_prefilter,
         initial_capacity=a.initial_capacity,
         flush_policy=a.flush_policy,
+        overlap_rows=a.overlap_rows,
+        ingest=a.ingest,
         mesh=a.mesh,
         stats_port=a.stats_port,
         window_size=a.window_size,

@@ -18,6 +18,7 @@ import sys
 import numpy as np
 
 from skyline_tpu.bridge.wire import format_trigger
+from skyline_tpu.native import format_tuples_native
 from skyline_tpu.workload.generators import (
     QUERY_THRESHOLD,
     SIMPLE_VARIANT,
@@ -30,6 +31,8 @@ def _build_sink(args):
         def send(topic, lines):
             out = sys.stdout
             for ln in lines:
+                if isinstance(ln, bytes):
+                    ln = ln.decode("utf-8")
                 out.write(f"{topic}\t{ln}\n")
         return send
     from skyline_tpu.bridge.kafka import KafkaBus
@@ -98,15 +101,22 @@ def main(argv=None):
         n = args.batch if args.count == 0 else min(args.batch, end_id - record_id)
         vals = generate(distribution, rng, n, args.dims, args.d_min, args.d_max)
         ids = np.arange(record_id, record_id + n, dtype=np.int64)
-        # integer-valued floats print without trailing .0 via int cast;
-        # vectorized column-wise formatting (np.char) — the per-value Python
-        # loop was the producer's bottleneck once the produce plane went
-        # native (benchmarks/e2e_transport.py)
-        arr = ids.astype(str)
+        # integer-valued floats print without trailing .0 via int cast; the
+        # C++ formatter (native/fastcsv.cpp sky_format_tuples) emits the
+        # whole batch into one buffer — formatting was the producer's
+        # dominant cost (np.char chain: ~69 s/1M x 8D on the dev box vs
+        # ~0.1 s native)
         iv = vals.astype(np.int64)
-        for k in range(args.dims):
-            arr = np.char.add(np.char.add(arr, ","), iv[:, k].astype(str))
-        lines = arr.tolist()
+        fmt = format_tuples_native(ids, iv)
+        if fmt is not None:
+            blob, offs = fmt
+            ot = offs.tolist()
+            lines = [blob[ot[i] : ot[i + 1]] for i in range(n)]
+        else:
+            lines = [
+                ",".join(map(str, (i, *row)))
+                for i, row in zip(ids.tolist(), iv.tolist())
+            ]
         send(args.topic, lines)
         record_id += n
         while args.query_threshold > 0 and record_id >= next_trigger:

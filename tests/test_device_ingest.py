@@ -1,0 +1,175 @@
+"""Device-resident ingest (stream/device_window.py + PartitionSet route):
+routing, (pid, sum) sort, and SFS block slicing on the accelerator must be
+result-identical to the host ingest path, including barrier semantics,
+window-buffer reuse across windows, bookkeeping counters, and checkpointing.
+On the CPU test platform "device" means the same backend, but the full code
+path (upload, device routing, sorted-window slicing) is exercised."""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.ops.dominance import skyline_np
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from conftest import assert_same_set
+
+
+def _anti(rng, n, d, domain=1000.0):
+    base = rng.uniform(0, domain, (n, 1))
+    return np.abs((domain - base) + rng.normal(0, 60, (n, d))).astype(
+        np.float32
+    )
+
+
+@pytest.mark.parametrize("policy", ["lazy", "overlap"])
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-grid", "mr-angle"])
+def test_device_ingest_matches_oracle(policy, algo, rng):
+    n, d = 5000, 4
+    x = _anti(rng, n, d)
+    ids = np.arange(n)
+    oracle_mid = skyline_np(x[:3000])
+    oracle = skyline_np(x)
+    cfg = EngineConfig(
+        parallelism=4, algo=algo, dims=d, domain_max=1000.0,
+        flush_policy=policy, ingest="device", overlap_rows=1024,
+        emit_skyline_points=True,
+    )
+    eng = SkylineEngine(cfg)
+    pos, results = 0, []
+    for stop in (3000, n):
+        while pos < stop:
+            e = min(pos + 700, stop)
+            eng.process_records(ids[pos:e], x[pos:e])
+            pos = e
+        eng.process_trigger(f"{len(results)},0")
+        results.extend(eng.poll_results())
+    assert results[0]["skyline_size"] == oracle_mid.shape[0]
+    assert results[1]["skyline_size"] == oracle.shape[0]
+    assert_same_set(results[1]["skyline_points"], oracle)
+
+
+def test_device_matches_host_barrier_deferral(rng):
+    """A trigger with a positive required id defers identically on both
+    ingest paths, and the deferred answers match row-for-row."""
+    n, d = 4000, 3
+    x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    ids = np.arange(n)
+    sizes = {}
+    for ingest in ("host", "device"):
+        cfg = EngineConfig(
+            parallelism=2, algo="mr-angle", dims=d, domain_max=1000.0,
+            flush_policy="lazy", ingest=ingest, emit_skyline_points=True,
+        )
+        eng = SkylineEngine(cfg)
+        eng.process_records(ids[:2000], x[:2000])
+        eng.process_trigger("0,3500")
+        assert eng.poll_results() == []
+        assert eng.inflight_queries == 1
+        for pos in range(2000, n, 300):
+            eng.process_records(ids[pos : pos + 300], x[pos : pos + 300])
+        (res,) = eng.poll_results()
+        sizes[ingest] = res["skyline_size"]
+        pts = res["skyline_points"]
+    assert sizes["host"] == sizes["device"]
+
+
+def test_window_buffer_reuse_masks_stale_rows(rng):
+    """A second, SMALLER window through the same engine must not resurrect
+    rows of the first window left in the reused device buffer."""
+    d = 3
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-grid", dims=d, domain_max=1000.0,
+        flush_policy="lazy", ingest="device", emit_skyline_points=True,
+    )
+    eng = SkylineEngine(cfg)
+    x1 = rng.uniform(0, 1000, (3000, d)).astype(np.float32)
+    eng.process_records(np.arange(3000), x1)
+    eng.process_trigger("0,0")
+    (r1,) = eng.poll_results()
+    # second window: 400 new rows; the union state is sky(x1) + x2
+    x2 = rng.uniform(0, 1000, (400, d)).astype(np.float32)
+    eng.process_records(np.arange(3000, 3400), x2)
+    eng.process_trigger("1,0")
+    (r2,) = eng.poll_results()
+    want = skyline_np(np.concatenate([x1, x2]))
+    assert r2["skyline_size"] == want.shape[0]
+    assert_same_set(r2["skyline_points"], want)
+
+
+def test_chunk_split_and_growth(rng):
+    """One giant process_records call splits into bucketed chunks and grows
+    the accumulation buffer; results still match the oracle."""
+    n, d = 150_000, 2
+    x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-dim", dims=d, domain_max=1000.0,
+        flush_policy="lazy", ingest="device",
+    )
+    eng = SkylineEngine(cfg)
+    eng.process_records(np.arange(n), x)
+    assert eng.pset.pending_rows_total == n
+    eng.process_trigger("0,0")
+    (res,) = eng.poll_results()
+    assert res["skyline_size"] == skyline_np(x).shape[0]
+
+
+def test_bookkeeping_counters_after_sync(rng):
+    n, d = 2000, 3
+    x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    ids = np.arange(100, 100 + n)
+    for ingest in ("host", "device"):
+        cfg = EngineConfig(
+            parallelism=2, algo="mr-angle", dims=d, domain_max=1000.0,
+            flush_policy="lazy", ingest=ingest,
+        )
+        eng = SkylineEngine(cfg)
+        eng.process_records(ids[:900], x[:900])
+        eng.process_records(ids[900:], x[900:])
+        s = eng.stats()
+        if ingest == "host":
+            want = s
+        else:
+            assert s["partitions"]["records_seen"] == want["partitions"]["records_seen"]
+            assert s["partitions"]["max_seen_id"] == want["partitions"]["max_seen_id"]
+            assert s["records_in"] == want["records_in"]
+
+
+def test_checkpoint_flushes_device_window(tmp_path, rng):
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    n, d = 3000, 3
+    x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-grid", dims=d, domain_max=1000.0,
+        flush_policy="lazy", ingest="device", emit_skyline_points=True,
+    )
+    eng = SkylineEngine(cfg)
+    eng.process_records(np.arange(2000), x[:2000])
+    path = str(tmp_path / "ck.npz")
+    save_engine(eng, path)
+    resumed = load_engine(path)
+    resumed.process_records(np.arange(2000, n), x[2000:])
+    resumed.process_trigger("0,0")
+    (res,) = resumed.poll_results()
+    want = skyline_np(x)
+    assert res["skyline_size"] == want.shape[0]
+    assert_same_set(res["skyline_points"], want)
+
+
+def test_large_ids_rejected():
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-dim", dims=2, domain_max=1000.0,
+        flush_policy="lazy", ingest="device",
+    )
+    eng = SkylineEngine(cfg)
+    with pytest.raises(ValueError, match="int32"):
+        eng.process_records(
+            np.array([2**31], dtype=np.int64),
+            np.zeros((1, 2), dtype=np.float32),
+        )
+
+
+def test_device_ingest_requires_lazy_single_device():
+    with pytest.raises(ValueError):
+        SkylineEngine(
+            EngineConfig(flush_policy="incremental", ingest="device")
+        )

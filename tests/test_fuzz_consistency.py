@@ -39,16 +39,19 @@ def run_fuzz_scenario(seed, max_n: int = 3000, min_n: int = 800):
 
     algo = str(rng.choice(["mr-dim", "mr-grid", "mr-angle"]))
     combos = [
-        ("incremental", None),
-        ("lazy", None),
-        ("incremental", make_mesh(4)),
-        ("lazy", make_mesh(4)),
+        ("incremental", None, "host"),
+        ("lazy", None, "host"),
+        ("lazy", None, "device"),
+        ("overlap", None, "device"),
+        ("incremental", make_mesh(4), "host"),
+        ("lazy", make_mesh(4), "host"),
     ]
-    for policy, mesh in combos:
+    for policy, mesh, ingest in combos:
         cfg = EngineConfig(
             parallelism=4, algo=algo, dims=d, domain_max=1000.0,
             buffer_size=int(rng.integers(64, 512)),
             flush_policy=policy, emit_skyline_points=True,
+            ingest=ingest, overlap_rows=int(rng.integers(128, 1024)),
         )
         eng = SkylineEngine(cfg, mesh=mesh)
         pos = 0

@@ -55,3 +55,53 @@ def test_vmap_self_dominance_matches_oracle(rng):
         keep = ~dom[p]
         sky = skyline_np(x[p])
         assert keep.sum() == sky.shape[0]
+
+
+# -- rank cascade (ops/pallas_dominance.py rank_transform + rank kernels) ---
+
+
+@pytest.mark.parametrize("dist", ["uniform", "anti", "ties"])
+def test_rank_mask_matches_value_mask_and_oracle(dist, rng):
+    from skyline_tpu.ops.pallas_dominance import (
+        skyline_mask_pallas,
+        skyline_mask_rank_pallas,
+    )
+
+    n, d = 1500, 4
+    if dist == "uniform":
+        x = rng.uniform(0, 1000, (n, d)).astype(np.float32)
+    elif dist == "anti":
+        base = rng.uniform(0, 1000, (n, 1))
+        x = np.abs((1000 - base) + rng.normal(0, 60, (n, d))).astype(
+            np.float32
+        )
+    else:  # heavy duplicates/ties: dense-rank tie semantics must match
+        x = rng.uniform(0, 8, (n, d)).round().astype(np.float32)
+    valid = rng.random(n) < 0.9
+    xd = jnp.asarray(x)
+    vd = jnp.asarray(valid)
+    mv = np.asarray(skyline_mask_pallas(xd, vd, interpret=True))
+    mr = np.asarray(skyline_mask_rank_pallas(xd, vd, interpret=True))
+    assert (mv == mr).all()
+    want = skyline_np(x[valid])
+    assert int(mr.sum()) == want.shape[0]
+
+
+def test_rank_transform_is_order_embedding(rng):
+    from skyline_tpu.ops.pallas_dominance import rank_transform
+
+    n, d = 600, 3
+    x = rng.uniform(0, 20, (n, d)).round().astype(np.float32)  # many ties
+    valid = np.ones(n, dtype=bool)
+    rt = np.asarray(rank_transform(jnp.asarray(x), jnp.asarray(valid)))
+    ranks = rt[:d].T  # (n, d)
+    assert np.allclose(rt[d], ranks.sum(axis=1))
+    for k in range(d):
+        a = x[:, k]
+        r = ranks[:, k]
+        i = rng.integers(0, n, 300)
+        j = rng.integers(0, n, 300)
+        lt = a[i] < a[j]
+        eq = a[i] == a[j]
+        assert (r[i][lt] < r[j][lt]).all()
+        assert (r[i][eq] == r[j][eq]).all()
