@@ -42,7 +42,8 @@ from deploy.launch import Stack, wait_for_broker  # noqa: E402
 
 
 def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
-               cpu: bool, timeout_s: float) -> dict:
+               cpu: bool, timeout_s: float,
+               flush_policy: str = "overlap") -> dict:
     os.makedirs(log_dir, exist_ok=True)
     csv_path = os.path.join(log_dir, f"e2e_{dims}d.csv")
     if os.path.isfile(csv_path):
@@ -66,7 +67,7 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
             ["-m", "skyline_tpu.bridge.worker", "--bootstrap", bootstrap,
              "--algo", "mr-angle", "--dims", str(dims),
              "--parallelism", "4", "--domain", "10000",
-             "--flush-policy", "lazy", "--stats-port", "0"],
+             "--flush-policy", flush_policy, "--stats-port", "0"],
             env=worker_env,
         )
         stack.start(
@@ -121,6 +122,7 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
                         "config": f"e2e_transport_{dims}d_anticorrelated",
                         "n": records,
                         "dims": dims,
+                        "flush_policy": flush_policy,
                         "wall_s": round(wall_s, 2),
                         "produce_s": round(produce_s, 2) if produce_s else None,
                         "tuples_per_sec_wall": round(records / wall_s, 1),
@@ -143,12 +145,18 @@ def main(argv=None):
     ap.add_argument("--bootstrap", default="127.0.0.1:19892")
     ap.add_argument("--log-dir", default="deploy_logs_e2e")
     ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--flush-policy", default="overlap",
+                    choices=("incremental", "lazy", "overlap"),
+                    help="worker flush policy; overlap runs device append "
+                         "rounds concurrently with transport ingest "
+                         "(round-4 default; round 3 measured lazy best "
+                         "before the device-ingest/overlap rework)")
     ap.add_argument("--out", default="artifacts/e2e_transport.json")
     a = ap.parse_args(argv)
     results = []
     for dims in a.dims:
         out = run_config(dims, a.records, a.bootstrap, a.log_dir, a.cpu,
-                         a.timeout)
+                         a.timeout, a.flush_policy)
         print(json.dumps(out), flush=True)
         results.append(out)
     if a.out:

@@ -585,9 +585,13 @@ class PartitionSet:
         partition's own rows instead of P x the heaviest. Returns the
         device counts vector."""
         # exact starting counts make the per-partition active buckets
-        # tight; sky_counts() is cached, so a had_old flush (which already
-        # synced) pays no extra round trip
-        counts_host = self.sky_counts().astype(np.int64)
+        # tight; a fresh set (all upper bounds zero) provably has zero
+        # counts, skipping the sync — through the remote-TPU tunnel each
+        # host<->device round trip costs real wall time
+        if not int(self._count_ub.max()):
+            counts_host = np.zeros(self.num_partitions, dtype=np.int64)
+        else:
+            counts_host = self.sky_counts().astype(np.int64)
         row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
 
         # capacity grows ON DEMAND as survivor counts actually grow (one
@@ -669,7 +673,12 @@ class PartitionSet:
         of the sorted window ``ws`` at host-tracked offsets instead of
         assembled from host rows — same probe/escalation, lag-2 tightening,
         and on-demand capacity growth. Returns the device counts vector."""
-        counts_host = self.sky_counts().astype(np.int64)
+        # fresh set: counts are provably zero, skip the sync (see
+        # _sfs_sequential)
+        if not int(self._count_ub.max()):
+            counts_host = np.zeros(self.num_partitions, dtype=np.int64)
+        else:
+            counts_host = self.sky_counts().astype(np.int64)
         widths = np.diff(bounds)
         # blocks sliced from the sorted window must fit its SORT_TAIL pad
         # (a dynamic_slice past the buffer clamps backward and desyncs the

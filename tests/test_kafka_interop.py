@@ -1,0 +1,75 @@
+"""Foreign-implementation interop for the kafkalite wire protocol.
+
+The golden-bytes tests (test_kafkalite_golden.py) pin frames against
+spec-derived assemblies; these tests close the loop against a REAL foreign
+implementation when one is available:
+
+- kafka-python client <-> kafkalite Broker (same-process TCP)
+- kafkalite client <-> external broker named by SKYLINE_INTEROP_BOOTSTRAP
+
+Both skip cleanly when the dependency is absent — this image has no
+kafka-python, no JVM, and no package egress (probe recorded in
+``artifacts/kafka_interop.json`` by scripts/kafka_interop.py), so on the
+build machine they skip; run them wherever kafka-python or a real broker
+exists.
+"""
+
+import os
+
+import pytest
+
+kafka = pytest.importorskip(
+    "kafka", reason="kafka-python not installed (see artifacts/kafka_interop.json)"
+)
+
+
+def test_kafka_python_roundtrip_against_kafkalite_broker():
+    from skyline_tpu.bridge.kafkalite.broker import Broker
+
+    with Broker() as b:
+        host, _, port = b.address.partition(":")
+        prod = kafka.KafkaProducer(
+            bootstrap_servers=b.address,
+            value_serializer=lambda s: s.encode("utf-8"),
+            api_version=(0, 11),
+        )
+        msgs = [f"{i},{i * 10},{i * 7}" for i in range(5000)]
+        for m in msgs:
+            prod.send("interop", m)
+        prod.flush()
+        cons = kafka.KafkaConsumer(
+            "interop",
+            bootstrap_servers=b.address,
+            auto_offset_reset="earliest",
+            value_deserializer=lambda v: v.decode("utf-8"),
+            consumer_timeout_ms=5000,
+            api_version=(0, 11),
+        )
+        got = [r.value for r in cons]
+        assert got == msgs
+
+
+def test_kafkalite_client_against_external_broker():
+    bootstrap = os.environ.get("SKYLINE_INTEROP_BOOTSTRAP")
+    if not bootstrap:
+        pytest.skip("set SKYLINE_INTEROP_BOOTSTRAP=host:port of a real broker")
+    from skyline_tpu.bridge.kafkalite.client import (
+        KafkaLiteConsumer,
+        KafkaLiteProducer,
+    )
+
+    prod = KafkaLiteProducer(bootstrap)
+    msgs = [f"interop-{i}" for i in range(2000)]
+    prod.send_many("skyline-interop-test", msgs)
+    prod.flush()
+    cons = KafkaLiteConsumer(
+        "skyline-interop-test", bootstrap, auto_offset_reset="earliest",
+        check_crcs=True,
+    )
+    got, idle = [], 0
+    while len(got) < len(msgs) and idle < 50:
+        batch = cons.poll(4096)
+        idle = 0 if batch else idle + 1
+        got.extend(batch)
+    # an external broker may hold earlier runs' records; ours must be the tail
+    assert got[-len(msgs):] == msgs
