@@ -13,12 +13,13 @@ native/fastcsv -> engine) -> collector (CSV) — and reports:
 
 Prints one JSON line per config and writes ``artifacts/e2e_transport.json``.
 
-Policy choice (measured, round 3, 8-D/1M warm): lazy 22.0 s wall / 12.2 s
-query latency vs incremental (buffer 262144) 61.0 s / 37.3 s — overlapping
-merges with the transport-bound ingest does not pay at high skyline
-fractions: each incremental flush re-prunes against the ~400k-row running
-skylines, tripling total dominance work. The runner therefore pins
-``--flush-policy lazy``.
+Policy choice: round 3 measured lazy 22.0 s wall vs incremental (buffer
+262144) 61.0 s at 8-D/1M warm — incremental re-prunes against the running
+~400k-row skylines every flush, tripling dominance work. Round 4 adds the
+``overlap`` policy (lazy SFS machinery flushed every overlap_rows, device
+rounds concurrent with transport ingest — the Flink-style source/operator
+overlap) plus device-resident ingest; the runner defaults to it
+(``--flush-policy`` overrides for A/Bs).
 
 Usage:
   python benchmarks/e2e_transport.py [--records 1000000] [--dims 2 8]

@@ -19,11 +19,27 @@ def on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def rank_cascade() -> bool:
+    """``SKYLINE_RANK_CASCADE`` selects the dense-rank dominance cascade
+    for the self-skyline passes (ops/pallas_dominance.py rank kernels) —
+    default ON for TPU (set ``=0`` to force the value cascade; the A/B is
+    committed as artifacts/rank_cascade_ab.json). Read lazily at trace
+    time; already-compiled executables are unaffected by later changes."""
+    import os
+
+    return os.environ.get("SKYLINE_RANK_CASCADE", "1") != "0"
+
+
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
     if on_tpu():
-        from skyline_tpu.ops.pallas_dominance import skyline_mask_pallas
+        from skyline_tpu.ops.pallas_dominance import (
+            skyline_mask_pallas,
+            skyline_mask_rank_pallas,
+        )
 
+        if rank_cascade():
+            return skyline_mask_rank_pallas(x, valid)
         return skyline_mask_pallas(x, valid)
     from skyline_tpu.ops.block_skyline import skyline_mask_scan
 

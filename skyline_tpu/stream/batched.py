@@ -914,7 +914,10 @@ class PartitionSet:
         active = min(
             self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
         )
-        union_cap = _next_pow2(max(int(self._count_ub.sum()), 1))
+        # quarter-pow2 ladder on the union too: the triangular pass costs
+        # O(union_cap^2), so the ladder's ~1.14x tighter bucket is ~1.3x
+        # less pairwise work at the north-star union (~437k rows)
+        union_cap = _active_bucket(max(int(self._count_ub.sum()), 1))
         union, keep, stats = global_merge_stats_device(
             self.sky, self._count_dev, active, union_cap
         )

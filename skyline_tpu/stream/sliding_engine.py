@@ -50,8 +50,12 @@ from skyline_tpu.stream.engine import (
 from skyline_tpu.utils.buckets import next_pow2
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _slide_step_batched(rings, ring_valids, slot, rows, rows_valid):
+@functools.partial(
+    jax.jit, static_argnames=("use_pallas",), donate_argnums=(0, 1)
+)
+def _slide_step_batched(
+    rings, ring_valids, slot, rows, rows_valid, use_pallas: bool = False
+):
     """Close one global slide across all partitions in one launch.
 
     rings (P, K, C, d), ring_valids (P, K, C), slot scalar int32 (same ring
@@ -59,17 +63,33 @@ def _slide_step_batched(rings, ring_valids, slot, rows, rows_valid):
     padded, rows_valid (P, C). Returns (rings', ring_valids', win_sky
     (P, K*C, d), win_valid (P, K*C), win_counts (P,)) with each partition's
     window skyline compacted to the front of its flat buffer.
+
+    ``use_pallas`` switches the two skyline passes to the VMEM-tiled
+    triangular Pallas kernel — the single-device TPU fast path (the
+    window-union pass is the slide cost at north-star shapes: at 8-D the
+    bucket skylines barely shrink, so the union is nearly K full buckets).
+    The meshed path keeps the pure-XLA scan kernels so GSPMD can partition
+    the P axis without a shard_map (module docstring).
     """
+    if use_pallas:
+        from skyline_tpu.ops.pallas_dominance import skyline_mask_pallas
+        from skyline_tpu.ops.sfs import pallas_interpret
+
+        mask = functools.partial(
+            skyline_mask_pallas, interpret=pallas_interpret()
+        )
+    else:
+        mask = skyline_mask_scan
 
     def core(ring, ring_valid, r, rv):
         k, c, d = ring.shape
-        bucket_keep = skyline_mask_scan(r, rv)
+        bucket_keep = mask(r, rv)
         bvals, bvalid, _ = compact(r, bucket_keep, c)
         ring = ring.at[slot].set(bvals)
         ring_valid = ring_valid.at[slot].set(bvalid)
         flat = ring.reshape(k * c, d)
         fvalid = ring_valid.reshape(k * c)
-        wkeep = skyline_mask_scan(flat, fvalid)
+        wkeep = mask(flat, fvalid)
         sky, sky_valid, count = compact(flat, wkeep, k * c)
         return ring, ring_valid, sky, sky_valid, count.astype(jnp.int32)
 
@@ -103,6 +123,15 @@ class SlidingEngine:
         # start capacity at the balanced-routing bucket (2x headroom over
         # slide/P); grows when routing skew overflows it
         self._cap = next_pow2(max(2 * slide // max(P, 1), 64), min_cap=128)
+        # single-device TPU: VMEM-tiled triangular Pallas kernel for the
+        # bucket + window-union skyline passes (see _slide_step_batched) —
+        # only once the flat window clears the kernel's 2048-row tile pad,
+        # below which the scan kernel's exact-size passes win
+        from skyline_tpu.ops.dispatch import on_tpu
+
+        self._use_pallas = (
+            mesh is None and on_tpu() and self.k * self._cap >= 8192
+        )
         self._sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -236,6 +265,7 @@ class SlidingEngine:
                 jnp.asarray(self._slot, dtype=jnp.int32),
                 self._put(rows),
                 self._put(rvalid),
+                use_pallas=self._use_pallas,
             )
             self._win_counts = np.asarray(counts, dtype=np.int64)
         self._win_host = None  # device cache replaced; host copy is stale
