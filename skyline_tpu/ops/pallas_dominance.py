@@ -103,17 +103,21 @@ def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
 
 def _dom_tile_rank(d: int, x_ref, y_ref, v_ref):
     """(R, C) dominance tile over per-dim dense ranks: rows 0..d-1 of the
-    refs are ranks, row d is the rank sum. 2 f32 VPU ops per dimension
-    (sub, max) plus one sum compare — the strict-dimension test the value
-    cascade pays a min-chain for collapses into the precomputed rank sums
-    (see module docstring for the exactness argument)."""
+    refs are ranks, row d is the rank sum — all INT32 (2 VPU ops per
+    dimension: sub, max; plus one sum compare). The strict-dimension test
+    the value cascade pays a min-chain for collapses into the precomputed
+    rank sums (see module docstring for the exactness argument). int32 is
+    load-bearing: rank sums reach d * universe (~2^25 at the 8-D/1M flush
+    with folded sky prefixes), past float32's 2^24 exact-integer limit —
+    an f32 rank-sum would tie where the true sums differ by 1 and silently
+    keep dominated rows."""
     diff = x_ref[0, :][:, None] - y_ref[0, :][None, :]
     mx = diff
     for k in range(1, d):
         mx = jnp.maximum(mx, x_ref[k, :][:, None] - y_ref[k, :][None, :])
     sd = x_ref[d, :][:, None] - y_ref[d, :][None, :]
     vmask = v_ref[0, :][:, None] > 0.5
-    return (mx <= 0.0) & (sd < 0.0) & vmask
+    return (mx <= 0) & (sd < 0) & vmask
 
 
 def _kernel_rank_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
@@ -150,9 +154,10 @@ def rank_transform(x: jax.Array, valid: jax.Array):
     x: (N, d); valid: (N,) bool. Invalid rows are ranked as +inf values:
     every dim gets rank n_valid (= count of finite entries), making them
     inert exactly like +inf padding in the value cascade (they tie other
-    pads, never strictly dominate). Returns ``rt (d+1, N) float32`` —
-    ranks transposed with the rank-sum as the extra last row, the layout
-    ``dominated_by_any_rank_pallas`` consumes.
+    pads, never strictly dominate). Returns ``rt (d+1, N) int32`` — ranks
+    transposed with the rank-sum as the extra last row, the layout
+    ``dominated_by_any_rank_pallas`` consumes. int32 keeps rank SUMS exact
+    past f32's 2^24 limit (see ``_dom_tile_rank``).
     """
     xm = jnp.where(valid[:, None], x, jnp.inf)
     sorted_cols = jnp.sort(xm, axis=0)
@@ -160,8 +165,8 @@ def rank_transform(x: jax.Array, valid: jax.Array):
         lambda col, sc: jnp.searchsorted(sc, col, side="left"),
         in_axes=(1, 1),
         out_axes=1,
-    )(xm, sorted_cols).astype(jnp.float32)
-    rsum = jnp.sum(ranks, axis=1, keepdims=True)
+    )(xm, sorted_cols).astype(jnp.int32)
+    rsum = jnp.sum(ranks, axis=1, keepdims=True, dtype=jnp.int32)
     return jnp.concatenate([ranks, rsum], axis=1).T
 
 
@@ -198,6 +203,40 @@ def dominated_by_any_rank_pallas(
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.bool_),
         interpret=interpret,
     )(rt, v2, rt)
+    return out[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+)
+def dominated_by_rank_pallas(
+    xt: jax.Array,
+    x_valid: jax.Array,
+    yt: jax.Array,
+    interpret: bool = False,
+    row_tile: int = ROW_TILE,
+    col_tile: int = COL_TILE,
+) -> jax.Array:
+    """Rank-cascade twin of ``dominated_by_pallas``: xt (d+1, Nx) dominator
+    ranks (+ rank-sum row), yt (d+1, Ny) victim ranks over the SAME rank
+    universe. Nx % row_tile == 0, Ny % col_tile == 0."""
+    dp1, nx = xt.shape
+    _, ny = yt.shape
+    rt, ct = min(row_tile, nx), min(col_tile, ny)
+    grid = (ny // ct, nx // rt)
+    v2 = x_valid[None, :].astype(jnp.float32)
+    out = pl.pallas_call(
+        functools.partial(_kernel_rank, dp1 - 1, rt, ct),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((dp1, rt), lambda j, i: (0, i)),
+            pl.BlockSpec((1, rt), lambda j, i: (0, i)),
+            pl.BlockSpec((dp1, ct), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, ct), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, ny), jnp.bool_),
+        interpret=interpret,
+    )(xt, v2, yt)
     return out[0]
 
 

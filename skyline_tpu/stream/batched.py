@@ -668,11 +668,13 @@ class PartitionSet:
             self._count_ub[p] = ub_p
         return self._restack_skies(new_skies, new_counts)
 
-    def _sfs_sequential_dev(self, ws, bounds: np.ndarray):
+    def _sfs_sequential_dev(self, ws, bounds: np.ndarray, rank=None):
         """Device-window twin of ``_sfs_sequential``: blocks are sliced out
         of the sorted window ``ws`` at host-tracked offsets instead of
         assembled from host rows — same probe/escalation, lag-2 tightening,
-        and on-demand capacity growth. Returns the device counts vector."""
+        and on-demand capacity growth. ``rank``: (ws_ranks, sorted_dims)
+        switches the rounds to the rank cascade. Returns the device counts
+        vector."""
         # fresh set: counts are provably zero, skip the sync (see
         # _sfs_sequential)
         if not int(self._count_ub.max()):
@@ -715,9 +717,15 @@ class PartitionSet:
                     w = min(B, hi - off)
                     active = min(cap_p, _active_bucket(max(ub_p, 1)))
                     with self.tracer.phase("flush/merge_kernel"):
-                        sky_p, cnt_p = dw.sfs_round_at(
-                            sky_p, cnt_p, ws, off, w, B=B, active=active
-                        )
+                        if rank is not None:
+                            sky_p, cnt_p = dw.sfs_round_at_rank(
+                                sky_p, cnt_p, ws, rank[0], rank[1],
+                                off, w, B=B, active=active,
+                            )
+                        else:
+                            sky_p, cnt_p = dw.sfs_round_at(
+                                sky_p, cnt_p, ws, off, w, B=B, active=active
+                            )
                         if self.tracer.sync_device:
                             np.asarray(cnt_p)
                     prev.append((cnt_p, w))
@@ -778,9 +786,13 @@ class PartitionSet:
             had_old = False
         return had_old, old_counts
 
-    def _finish_lazy_flush(self, counts, had_old, old_counts, t0) -> None:
+    def _finish_lazy_flush(
+        self, counts, had_old, old_counts, t0, rank=None
+    ) -> None:
         """Shared tail of the lazy flush paths: old-vs-new cleanup,
-        validity/caches, one bound-tightening sync."""
+        validity/caches, one bound-tightening sync. ``rank``: (ws_ranks,
+        sorted_dims) from the rank-cascade device flush — the cleanup then
+        compares in rank space (old prefixes are universe members)."""
         if had_old:
             old_active = min(
                 self._cap, _active_bucket(max(int(old_counts.max()), 1))
@@ -789,7 +801,16 @@ class PartitionSet:
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/merge_kernel"):
-                if self.mesh is not None:
+                if rank is not None:
+                    self.sky, counts = dw.sfs_cleanup_rank(
+                        self.sky,
+                        counts,
+                        jnp.asarray(old_counts),
+                        rank[1],
+                        old_active,
+                        active,
+                    )
+                elif self.mesh is not None:
                     cl = meshed_sfs_cleanup(
                         self.mesh, self.mesh.axis_names[0], on_tpu(),
                         old_active, active,
@@ -837,19 +858,46 @@ class PartitionSet:
             bounds = np.asarray(bounds_dev, dtype=np.int64)
         self._dev_rows = 0
         had_old, old_counts = self._check_had_old()
+        # rank-cascade mode: rank the window (+ live sky prefixes, which
+        # must share the rank universe) once per flush; the rounds then
+        # compare dense ranks instead of values (2 VPU ops/dim + one
+        # rank-sum compare vs 3/dim — see ops/pallas_dominance.py)
+        rank = None
+        if dw.rank_flush_enabled():
+            active_old = (
+                min(self._cap, _active_bucket(max(int(old_counts.max()), 1)))
+                if had_old
+                else 0
+            )
+            univ_bucket = _next_pow2(
+                n_bucket + self.num_partitions * active_old
+            )
+            with self.tracer.phase("flush/rank"):
+                sorted_dims, wr = dw.rank_window(
+                    ws,
+                    self.sky,
+                    self._count_dev,
+                    n_bucket,
+                    active_old,
+                    univ_bucket,
+                )
+            rank = (wr, sorted_dims)
         widths = np.diff(bounds)
         max_rows = int(widths.max())
         total_rows = int(widths.sum())
         # same skew heuristic as the host path (see _flush_lazy)
         if self.num_partitions * max_rows > 2 * total_rows:
-            counts = self._sfs_sequential_dev(ws, bounds)
+            counts = self._sfs_sequential_dev(ws, bounds, rank)
         else:
-            counts = self._sfs_vmapped_dev(ws, bounds, max_rows)
-        self._finish_lazy_flush(counts, had_old, old_counts, t0)
+            counts = self._sfs_vmapped_dev(ws, bounds, max_rows, rank)
+        self._finish_lazy_flush(counts, had_old, old_counts, t0, rank)
 
-    def _sfs_vmapped_dev(self, ws, bounds: np.ndarray, max_rows: int):
+    def _sfs_vmapped_dev(
+        self, ws, bounds: np.ndarray, max_rows: int, rank=None
+    ):
         """Device-window twin of ``_sfs_vmapped``: one vmapped launch per
         round, every lane slicing its block from the shared sorted window.
+        ``rank``: (ws_ranks, sorted_dims) switches to the rank cascade.
         Returns the device counts vector."""
         # cap at SORT_TAIL: see _sfs_sequential_dev's B_max note
         B = min(
@@ -880,15 +928,18 @@ class PartitionSet:
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
             with self.tracer.phase("flush/merge_kernel"):
-                self.sky, counts = dw.sfs_round_at_vmapped(
-                    self.sky,
-                    counts,
-                    ws,
-                    jnp.asarray(offs.astype(np.int32)),
-                    jnp.asarray(w.astype(np.int32)),
-                    B=B,
-                    active=active,
-                )
+                offs_d = jnp.asarray(offs.astype(np.int32))
+                w_d = jnp.asarray(w.astype(np.int32))
+                if rank is not None:
+                    self.sky, counts = dw.sfs_round_at_rank_vmapped(
+                        self.sky, counts, ws, rank[0], rank[1],
+                        offs_d, w_d, B=B, active=active,
+                    )
+                else:
+                    self.sky, counts = dw.sfs_round_at_vmapped(
+                        self.sky, counts, ws, offs_d, w_d,
+                        B=B, active=active,
+                    )
                 if self.tracer.sync_device:
                     np.asarray(counts)
             prev.append((counts, w))

@@ -122,6 +122,200 @@ def sort_window(
     return ws, bounds
 
 
+def _searchsorted_cols(sorted_cols, q):
+    """Per-dim searchsorted: sorted_cols (M, d) ascending per column,
+    q (N, d) queries -> dense ranks (N, d) int32 (int32 keeps rank sums
+    exact past f32's 2^24 limit — ops/pallas_dominance._dom_tile_rank)."""
+    return jax.vmap(
+        lambda sc, col: jnp.searchsorted(sc, col, side="left"),
+        in_axes=(1, 1),
+        out_axes=1,
+    )(sorted_cols, q).astype(jnp.int32)
+
+
+def rank_flush_enabled() -> bool:
+    """Rank-cascade SFS flush: enabled when the rank kernels can run (TPU,
+    or interpret mode for tests) and ``SKYLINE_RANK_CASCADE`` is not 0.
+    Read lazily at trace/flush time."""
+    from skyline_tpu.ops.dispatch import on_tpu, rank_cascade
+
+    return rank_cascade() and (on_tpu() or pallas_interpret())
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_bucket", "active_old", "univ_bucket"),
+)
+def rank_window(
+    ws,
+    sky,
+    counts,
+    n_bucket: int,
+    active_old: int,
+    univ_bucket: int,
+):
+    """Rank preprocessing for the rank-cascade SFS flush: the compared
+    universe is the sorted window's rows PLUS every partition's live
+    skyline prefix (old survivors act as dominators against new blocks and
+    as cleanup victims, so they must share the rank space — dense ranks
+    are exact only over universe members, ops/pallas_dominance.py).
+
+    ws: (n_bucket + tail, d) sorted window; sky: (P, cap, d) with
+    ``active_old`` bounding live prefixes (0 = fresh set, universe is the
+    window alone). Invalid rows are +inf and rank as the max (inert).
+
+    Returns (sorted_dims (univ_bucket, d) — per-dim ascending universe for
+    ranking arbitrary universe members later (sky prefixes per round), and
+    ws_ranks (same leading extent as ws, d + 1) — the window rows' ranks
+    with the rank-sum as the last column, sliceable exactly like ``ws``
+    (its +inf tail rows rank as the max: inert).
+    """
+    P, cap, d = sky.shape
+    w = lax.slice(ws, (0, 0), (n_bucket, d))
+    if active_old:
+        act = lax.slice(sky, (0, 0, 0), (P, active_old, d)).reshape(
+            P * active_old, d
+        )
+        # rows at or past each partition's count are +inf already (compact
+        # / SFS-append invariants) except garbage is impossible: both flush
+        # paths pad with +inf. Mask defensively against counts anyway.
+        ok = (
+            jnp.arange(active_old)[None, :] < counts[:, None]
+        ).reshape(P * active_old)
+        act = jnp.where(ok[:, None], act, jnp.inf)
+        univ = jnp.concatenate([w, act], axis=0)
+    else:
+        univ = w
+    pad = univ_bucket - univ.shape[0]
+    if pad > 0:
+        univ = jnp.concatenate(
+            [univ, jnp.full((pad, d), jnp.inf, univ.dtype)], axis=0
+        )
+    sorted_dims = jnp.sort(univ, axis=0)
+    ranks = _searchsorted_cols(sorted_dims, ws)
+    rsum = jnp.sum(ranks, axis=1, keepdims=True, dtype=jnp.int32)
+    return sorted_dims, jnp.concatenate([ranks, rsum], axis=1)
+
+
+def _rank_rows(sorted_dims, rows):
+    """Rank arbitrary universe-member rows against the per-dim sorted
+    universe -> (N, d+1) int32 ranks+ranksum (transposed layout NOT
+    applied)."""
+    r = _searchsorted_cols(sorted_dims, rows)
+    return jnp.concatenate(
+        [r, jnp.sum(r, axis=1, keepdims=True, dtype=jnp.int32)], axis=1
+    )
+
+
+def _sfs_round_rank_core(
+    sky_p, count, win, wr, sorted_dims, off, width, B: int, active: int,
+    interp: bool,
+):
+    """Rank-cascade SFS round body: dominance passes over dense ranks,
+    append in value space. The sky's active prefix is re-ranked in-jit per
+    round (d searchsorteds over ``active`` rows — amortized against the
+    O(B x active) pairwise pass)."""
+    from skyline_tpu.ops.pallas_dominance import (
+        dominated_by_any_rank_pallas,
+        dominated_by_rank_pallas,
+    )
+
+    d = win.shape[1]
+    zero = jnp.zeros((), jnp.int32)
+    block = lax.dynamic_slice(win, (off, zero), (B, d))
+    block_r = lax.dynamic_slice(wr, (off, zero), (B, d + 1))
+    bvalid = jnp.arange(B) < width
+    block = jnp.where(bvalid[:, None], block, jnp.inf)
+    # invalid tail rows: force ranks to the max so they are inert exactly
+    # like +inf values (their true ranks belong to the NEXT partition's
+    # rows, which are live universe members and would not be inert)
+    block_r = jnp.where(
+        bvalid[:, None], block_r, jnp.int32(sorted_dims.shape[0] * (d + 1))
+    )
+    sky_act = lax.slice(sky_p, (0, 0), (active, d))
+    sky_ok = jnp.arange(active) < count
+    sky_r = _rank_rows(sorted_dims, sky_act)
+    block_rt = block_r.T
+    keep = bvalid & ~dominated_by_any_rank_pallas(
+        block_rt, bvalid, triangular=True, interpret=interp
+    )
+    keep = keep & ~dominated_by_rank_pallas(
+        sky_r.T, sky_ok, block_rt, interpret=interp
+    )
+    from skyline_tpu.ops.dominance import compact
+
+    vals, _, m = compact(block, keep, B)
+    sky_p = lax.dynamic_update_slice(sky_p, vals, (count, zero))
+    return sky_p, count + m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+)
+def sfs_round_at_rank(
+    sky_p, count, win, wr, sorted_dims, off, width, *, B: int, active: int
+):
+    """Single-partition rank-cascade round (see ``_sfs_round_rank_core``)."""
+    return _sfs_round_rank_core(
+        sky_p, count, win, wr, sorted_dims, off, width, B, active,
+        pallas_interpret(),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+)
+def sfs_round_at_rank_vmapped(
+    sky, counts, win, wr, sorted_dims, offs, widths, *, B: int, active: int
+):
+    """Vmapped rank-cascade round over all partitions."""
+    interp = pallas_interpret()
+
+    def core(s, c, off, width):
+        return _sfs_round_rank_core(
+            s, c, win, wr, sorted_dims, off, width, B, active, interp
+        )
+
+    return jax.vmap(core)(sky, counts, offs, widths)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("old_active", "active"),
+    donate_argnums=(0,),
+)
+def sfs_cleanup_rank(
+    sky, counts, old_counts, sorted_dims, old_active: int, active: int
+):
+    """Rank-cascade twin of ``ops.sfs.sfs_cleanup``: prune old rows
+    dominated by newly appended rows, comparing in rank space (both row
+    sets are universe members — old prefixes were folded into the rank
+    universe by ``rank_window``)."""
+    from skyline_tpu.ops.dominance import compact
+    from skyline_tpu.ops.pallas_dominance import dominated_by_rank_pallas
+
+    interp = pallas_interpret()
+    P, cap, d = sky.shape
+
+    def core(s, c, old_c):
+        act = lax.slice(s, (0, 0), (active, d))
+        new_ok = (jnp.arange(active) >= old_c) & (jnp.arange(active) < c)
+        old = lax.slice(s, (0, 0), (old_active, d))
+        act_r = _rank_rows(sorted_dims, act)
+        old_r = _rank_rows(sorted_dims, old)
+        old_dom = dominated_by_rank_pallas(
+            act_r.T, new_ok, old_r.T, interpret=interp
+        )
+        old_keep = (jnp.arange(old_active) < old_c) & ~old_dom
+        keep = jnp.zeros((cap,), dtype=bool)
+        keep = keep.at[:active].set(new_ok)
+        keep = keep.at[:old_active].set(old_keep | new_ok[:old_active])
+        vals, _, cnt = compact(s, keep, cap)
+        return vals, cnt.astype(jnp.int32)
+
+    return jax.vmap(core)(sky, counts, old_counts)
+
+
 @functools.partial(
     jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
 )

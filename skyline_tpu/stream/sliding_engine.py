@@ -298,6 +298,13 @@ class SlidingEngine:
             jnp.concatenate([self._ring_valids, vpad], axis=2)
         )
         self._cap = new_cap
+        # growth can push the flat window past the Pallas tile-pad
+        # threshold; re-evaluate the fast-path gate (constructor note)
+        from skyline_tpu.ops.dispatch import on_tpu
+
+        self._use_pallas = (
+            self.mesh is None and on_tpu() and self.k * self._cap >= 8192
+        )
 
     # -- control plane ----------------------------------------------------
 

@@ -173,3 +173,44 @@ def test_device_ingest_requires_lazy_single_device():
         SkylineEngine(
             EngineConfig(flush_policy="incremental", ingest="device")
         )
+
+
+@pytest.mark.parametrize("algo", ["mr-dim", "mr-angle"])
+def test_rank_flush_matches_oracle_multiwindow(algo, rng, monkeypatch):
+    """The rank-cascade SFS flush (device path + interpret-mode Pallas)
+    must match the oracle across TWO flushes — the second exercises the
+    shared rank universe (window + live sky prefixes) and the rank-space
+    cleanup."""
+    monkeypatch.setenv("SKYLINE_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("SKYLINE_RANK_CASCADE", "1")
+    from skyline_tpu.stream import device_window as dw
+
+    assert dw.rank_flush_enabled()
+    n, d = 4000, 4
+    x = _anti(rng, n, d)
+    # duplicates across the flush boundary: tie semantics under ranks
+    x[2100:2110] = x[100:110]
+    cfg = EngineConfig(
+        parallelism=2, algo=algo, dims=d, domain_max=1000.0,
+        flush_policy="lazy", ingest="device", emit_skyline_points=True,
+    )
+    eng = SkylineEngine(cfg)
+    eng.process_records(np.arange(2000), x[:2000])
+    eng.process_trigger("0,0")
+    (r1,) = eng.poll_results()
+    want1 = skyline_np(x[:2000])
+    assert r1["skyline_size"] == want1.shape[0]
+    eng.process_records(np.arange(2000, n), x[2000:])
+    eng.process_trigger("1,0")
+    (r2,) = eng.poll_results()
+    want2 = skyline_np(x)
+    assert r2["skyline_size"] == want2.shape[0]
+    assert_same_set(r2["skyline_points"], want2)
+
+
+def test_rank_flush_off_by_env(rng, monkeypatch):
+    monkeypatch.setenv("SKYLINE_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("SKYLINE_RANK_CASCADE", "0")
+    from skyline_tpu.stream import device_window as dw
+
+    assert not dw.rank_flush_enabled()

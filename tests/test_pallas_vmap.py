@@ -105,3 +105,34 @@ def test_rank_transform_is_order_embedding(rng):
         eq = a[i] == a[j]
         assert (r[i][lt] < r[j][lt]).all()
         assert (r[i][eq] == r[j][eq]).all()
+
+
+def test_rank_sums_exact_past_f32_limit():
+    """Rank sums exceed f32's 2^24 exact-integer range at the 8-D/1M flush
+    scale; the int32 rank layout must resolve a sum difference of exactly 1
+    there (an f32 layout ties and silently keeps the dominated row)."""
+    from skyline_tpu.ops.pallas_dominance import dominated_by_rank_pallas
+
+    d, n = 8, 1024
+    base = 2_097_152  # per-dim rank ~2^21: rsum ~2^24.03
+    rt = np.full((d + 1, n), 0, dtype=np.int32)
+    # row 0: dominator with ranks [base]*8; row 1: victim differing by +1
+    # in one dim -> rsum differs by exactly 1 at ~16.8M
+    rt[:d, 0] = base
+    rt[d, 0] = d * base
+    rt[:d, 1] = base
+    rt[0, 1] = base + 1
+    rt[d, 1] = d * base + 1
+    assert float(np.float32(d * base)) == float(np.float32(d * base + 1)), (
+        "test premise: these sums are indistinguishable in f32"
+    )
+    valid = np.zeros(n, dtype=bool)
+    valid[:2] = True
+    dom = np.asarray(
+        dominated_by_rank_pallas(
+            jnp.asarray(rt), jnp.asarray(valid), jnp.asarray(rt),
+            interpret=True,
+        )
+    )
+    assert bool(dom[1]), "victim with rsum+1 must be detected as dominated"
+    assert not bool(dom[0])
