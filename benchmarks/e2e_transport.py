@@ -39,7 +39,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 # one process-supervision implementation: the deployment launcher owns it
-from deploy.launch import Stack, wait_for_broker  # noqa: E402
+from deploy.launch import CPU_PLANE_ENV, Stack, wait_for_broker  # noqa: E402
 
 
 def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
@@ -56,13 +56,13 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
             "broker",
             ["-m", "skyline_tpu.bridge.kafkalite.broker",
              "--host", host, "--port", port],
-            env={"JAX_PLATFORMS": "cpu"},
+            env=CPU_PLANE_ENV,
         )
         wait_for_broker(bootstrap)
         # workers share the checkout-local compile cache via
         # default_cache_dir(); SKYLINE_COMPILE_CACHE overrides it if the
         # operator relocated the cache
-        worker_env = {"JAX_PLATFORMS": "cpu"} if cpu else None
+        worker_env = dict(CPU_PLANE_ENV) if cpu else None
         stack.start(
             "worker",
             ["-m", "skyline_tpu.bridge.worker", "--bootstrap", bootstrap,
@@ -75,7 +75,7 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
             "collector",
             ["-m", "skyline_tpu.metrics.collector", csv_path,
              "--bootstrap", bootstrap],
-            env={"JAX_PLATFORMS": "cpu"},
+            env=CPU_PLANE_ENV,
         )
         # wait for the worker's query subscription (latest offsets) before
         # producing the trigger-bearing stream
@@ -100,7 +100,7 @@ def run_config(dims: int, records: int, bootstrap: str, log_dir: str,
              "--count", str(records), "--seed", "0",
              "--query-threshold", "0", "--final-trigger",
              "--bootstrap", bootstrap],
-            env={"JAX_PLATFORMS": "cpu"},
+            env=CPU_PLANE_ENV,
         )
         produce_s = None
         deadline = time.time() + timeout_s

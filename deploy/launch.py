@@ -32,6 +32,12 @@ import time
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# Host-side plane processes (broker / collector / producer / CPU workers)
+# pin the CPU backend AND clear the axon pool env so sitecustomize skips
+# the TPU plugin registration -- a ~4 s jax import per process otherwise
+CPU_PLANE_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+
 class Stack:
     def __init__(self, log_dir: str):
         self.procs: list[tuple[str, subprocess.Popen]] = []
@@ -133,7 +139,7 @@ def main(argv=None) -> int:
         ap.error("--window and --slide must be given together")
 
     stack = Stack(args.log_dir)
-    worker_env = {"JAX_PLATFORMS": "cpu"} if args.cpu else None
+    worker_env = dict(CPU_PLANE_ENV) if args.cpu else None
     try:
         if not args.external_broker:
             host, _, port = args.bootstrap.partition(":")
@@ -166,7 +172,7 @@ def main(argv=None) -> int:
             "collector",
             ["-m", "skyline_tpu.metrics.collector", csv_path,
              "--bootstrap", args.bootstrap],
-            env={"JAX_PLATFORMS": "cpu"},
+            env=CPU_PLANE_ENV,
         )
         # wait for the worker's startup banner: its latest-offset query
         # consumer subscribes during construction, and a trigger produced
@@ -200,7 +206,7 @@ def main(argv=None) -> int:
                  # the reference's own producer is an infinite loop)
                  "--query-threshold", "0", "--final-trigger",
                  "--bootstrap", args.bootstrap],
-                env={"JAX_PLATFORMS": "cpu"},
+                env=CPU_PLANE_ENV,
             )
             deadline = time.time() + 600
             while time.time() < deadline:

@@ -68,6 +68,19 @@ class KafkaBus:
                 self._producer.send(topic, m)
         self._producer.flush()
 
+    def produce_blob(self, topic: str, blob: bytes, offsets) -> None:
+        """Produce records from one value blob + prefix offsets (the native
+        formatter's output) without per-record bytes objects where the
+        backend supports it (kafkalite ``send_blob``)."""
+        send_blob = getattr(self._producer, "send_blob", None)
+        if send_blob is not None:
+            send_blob(topic, blob, offsets)
+            return
+        ot = list(offsets)  # pragma: no cover - kafka-python path
+        self.produce_many(
+            topic, [blob[ot[i] : ot[i + 1]] for i in range(len(ot) - 1)]
+        )
+
     def consumer(self, topic: str, from_beginning: bool = True):
         reset = "earliest" if from_beginning else "latest"
         if HAVE_KAFKA:  # pragma: no cover - not in the baked image

@@ -120,6 +120,61 @@ class KafkaLiteProducer:
             if should_flush:
                 self.flush()
 
+    def send_blob(self, topic: str, blob: bytes, offsets) -> None:
+        """Produce a whole formatted batch from one value blob + prefix
+        offsets (record i = ``blob[offsets[i]:offsets[i+1]]``) without
+        materializing per-record bytes objects — the zero-copy pairing for
+        the native CSV formatter (native/fastcsv.cpp). Splits into
+        max_request_size-bounded RecordBatches; falls back to ``send_many``
+        when the native record encoder is unavailable. Flushes buffered
+        sends first so ordering with ``send`` is preserved."""
+        import numpy as np
+
+        from skyline_tpu.bridge.kafkalite.protocol import (
+            encode_record_batch_blob,
+        )
+
+        self.flush()
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        n = offs.shape[0] - 1
+        if n <= 0:
+            return
+        # greedy grouping under the request cap, counting per-record frame
+        # overhead at its bound (native encoder sizing); the conservative
+        # headroom only shrinks groups — a single record is judged by its
+        # ACTUAL encoded batch below, so records near the cap that
+        # send/send_many would accept are accepted here too
+        from skyline_tpu.native import RECORD_FRAME_OVERHEAD
+
+        budget = max(self.max_request_size - 4096, 1)
+        adj = offs + RECORD_FRAME_OVERHEAD * np.arange(n + 1, dtype=np.int64)
+        i = 0
+        while i < n:
+            j = int(np.searchsorted(adj, adj[i] + budget, side="right")) - 1
+            j = max(j, i + 1)
+            batch = encode_record_batch_blob(
+                blob, offs[i : j + 1],
+                base_timestamp=int(time.time() * 1000),
+            )
+            if batch is not None and len(batch) > self.max_request_size:
+                if j > i + 1:  # conservative group overshot: halve and retry
+                    budget = max(budget // 2, 1)
+                    continue
+                raise MessageSizeTooLargeError(
+                    f"single record encodes to {len(batch)} bytes "
+                    f"> max_request_size {self.max_request_size}"
+                )
+            if batch is None:
+                # native encoder unavailable: per-record fallback
+                ot = offs.tolist()
+                self.send_many(
+                    topic, [blob[ot[k] : ot[k + 1]] for k in range(i, n)]
+                )
+                self.flush()
+                return
+            self._produce_batch(topic, batch)
+            i = j
+
     def flush(self) -> None:
         with self._lock:
             buf, self._buf = self._buf, {}
@@ -151,46 +206,53 @@ class KafkaLiteProducer:
                     f"batch of {len(values)} records is {len(batch)} bytes "
                     f"> max_request_size {self.max_request_size}"
                 )
-            body = (
-                P.Writer()
-                .string(None)  # transactional_id
-                .int16(1)  # acks
-                .int32(30_000)  # timeout_ms
-                .array(
-                    [(topic, batch)],
-                    lambda w, t: w.string(t[0]).array(
-                        [(0, t[1])],
-                        lambda w, p: w.int32(p[0]).bytes_(p[1]),
-                    ),
-                )
-                .build()
-            )
-            r = self._conn.request(P.API_PRODUCE, 3, body)
-
-            def read_pr(rr: P.Reader):
-                part = rr.int32()
-                err = rr.int16()
-                base = rr.int64()
-                rr.int64()  # log_append_time
-                return part, err, base
-
-            responses = r.array(
-                lambda rr: (rr.string(), rr.array(read_pr))
-            )
-            for _name, prs in responses or []:
-                for _part, err, _base in prs or []:
-                    if err == P.ERR_MESSAGE_TOO_LARGE:
-                        # acked as failed: do NOT restore (a too-large batch
-                        # would wedge every retry); drop it like kafka-python
-                        pending.pop(topic, None)
-                        raise MessageSizeTooLargeError(
-                            f"broker rejected batch for {topic}: message too large"
-                        )
-                    if err != P.ERR_NONE:
-                        raise KafkaLiteError(
-                            f"produce to {topic} failed: error {err}"
-                        )
+            try:
+                self._produce_batch(topic, batch)
+            except MessageSizeTooLargeError:
+                # acked as failed: do NOT restore (a too-large batch
+                # would wedge every retry); drop it like kafka-python
+                pending.pop(topic, None)
+                raise
             pending.pop(topic, None)  # acked: nothing to restore for this topic
+
+    def _produce_batch(self, topic: str, batch: bytes) -> None:
+        """One Produce request carrying one preassembled RecordBatch."""
+        body = (
+            P.Writer()
+            .string(None)  # transactional_id
+            .int16(1)  # acks
+            .int32(30_000)  # timeout_ms
+            .array(
+                [(topic, batch)],
+                lambda w, t: w.string(t[0]).array(
+                    [(0, t[1])],
+                    lambda w, p: w.int32(p[0]).bytes_(p[1]),
+                ),
+            )
+            .build()
+        )
+        r = self._conn.request(P.API_PRODUCE, 3, body)
+
+        def read_pr(rr: P.Reader):
+            part = rr.int32()
+            err = rr.int16()
+            base = rr.int64()
+            rr.int64()  # log_append_time
+            return part, err, base
+
+        responses = r.array(
+            lambda rr: (rr.string(), rr.array(read_pr))
+        )
+        for _name, prs in responses or []:
+            for _part, err, _base in prs or []:
+                if err == P.ERR_MESSAGE_TOO_LARGE:
+                    raise MessageSizeTooLargeError(
+                        f"broker rejected batch for {topic}: message too large"
+                    )
+                if err != P.ERR_NONE:
+                    raise KafkaLiteError(
+                        f"produce to {topic} failed: error {err}"
+                    )
 
     def close(self) -> None:
         self.flush()

@@ -379,7 +379,18 @@ def encode_record_batch(
         parts.append(_uvarint(len(rb) << 1))
         parts.append(rb)
     records_bytes = b"".join(parts)
+    return _wrap_record_batch(
+        records_bytes, n_records, base_offset, base_timestamp
+    )
 
+
+def _wrap_record_batch(
+    records_bytes: bytes,
+    n_records: int,
+    base_offset: int,
+    base_timestamp: int,
+) -> bytes:
+    """RecordBatch v2 header + CRC around preassembled record frames."""
     after_crc = (
         Writer()
         .int16(0)  # attributes: no compression, create-time timestamps
@@ -397,6 +408,26 @@ def encode_record_batch(
     tail = Writer().int32(-1).int8(2).uint32(crc).raw(after_crc).build()
     # batchLength counts partitionLeaderEpoch(4)+magic(1)+crc(4)+after_crc
     return Writer().int64(base_offset).int32(len(tail)).raw(tail).build()
+
+
+def encode_record_batch_blob(
+    blob: bytes,
+    offsets,
+    base_offset: int = 0,
+    base_timestamp: int = 0,
+) -> bytes | None:
+    """RecordBatch v2 straight from a value blob + prefix offsets (record i
+    is ``blob[offsets[i]:offsets[i+1]]``, key=None) — the zero-rejoin twin
+    of ``encode_record_batch`` for the native produce plane. Returns None
+    when the native encoder is unavailable (callers slice and fall back)."""
+    from skyline_tpu.native import encode_records_from_blob
+
+    records_bytes = encode_records_from_blob(blob, offsets)
+    if records_bytes is None:
+        return None
+    return _wrap_record_batch(
+        records_bytes, len(offsets) - 1, base_offset, base_timestamp
+    )
 
 
 def iter_batch_spans(data: bytes):

@@ -149,23 +149,29 @@ def format_tuples_native(ids: np.ndarray, values: np.ndarray):
     return out[:w].tobytes(), offsets
 
 
-def encode_records_native(values: list[bytes]):
-    """Kafka RecordBatch v2 record frames for value-only records (the
-    produce-plane hot loop); None if unavailable. Byte-identical to the
-    Python loop in bridge/kafkalite/protocol.py (golden-bytes tested)."""
+# per-record frame overhead bound used to size native encode outputs and
+# the blob produce path's batch grouping: <=2B length + 3 fixed +
+# <=2B offsetDelta + <=2B valueLen + 1 header count, padded generously
+RECORD_FRAME_OVERHEAD = 24
+
+
+def encode_records_from_blob(blob: bytes, offsets):
+    """Kafka RecordBatch v2 record frames straight from a value blob +
+    prefix offsets (record i = ``blob[offsets[i]:offsets[i+1]]``; offsets
+    may be absolute into a larger blob — the native encoder reads
+    ``values + offsets[i]`` directly). None if unavailable."""
     lib = get_lib()
     if lib is None or not hasattr(lib, "sky_encode_records"):
         return None
-    n = len(values)
-    offsets = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum([len(v) for v in values], out=offsets[1:])
-    blob = b"".join(values)
-    # frame overhead per record: <=2B length + 3 fixed + <=2B offsetDelta
-    # + <=2B valueLen + 1 header count, padded generously
-    out = np.empty(offsets[-1] + 24 * n + 64, dtype=np.uint8)
+    offs = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offs.shape[0] - 1
+    out = np.empty(
+        int(offs[-1] - offs[0]) + RECORD_FRAME_OVERHEAD * n + 64,
+        dtype=np.uint8,
+    )
     w = lib.sky_encode_records(
         blob,
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         out.shape[0],
@@ -173,3 +179,13 @@ def encode_records_native(values: list[bytes]):
     if w < 0:
         return None
     return out[:w].tobytes()
+
+
+def encode_records_native(values: list[bytes]):
+    """Kafka RecordBatch v2 record frames for value-only records (the
+    produce-plane hot loop); None if unavailable. Byte-identical to the
+    Python loop in bridge/kafkalite/protocol.py (golden-bytes tested)."""
+    n = len(values)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in values], out=offsets[1:])
+    return encode_records_from_blob(b"".join(values), offsets)

@@ -27,6 +27,7 @@ from skyline_tpu.workload.generators import (
 
 
 def _build_sink(args):
+    """Returns (send(topic, lines), send_blob(topic, blob, offsets) | None)."""
     if args.sink == "stdout":
         def send(topic, lines):
             out = sys.stdout
@@ -34,7 +35,7 @@ def _build_sink(args):
                 if isinstance(ln, bytes):
                     ln = ln.decode("utf-8")
                 out.write(f"{topic}\t{ln}\n")
-        return send
+        return send, None
     from skyline_tpu.bridge.kafka import KafkaBus
 
     bus = KafkaBus(args.bootstrap)
@@ -42,7 +43,7 @@ def _build_sink(args):
     def send(topic, lines):
         bus.produce_many(topic, lines)
 
-    return send
+    return send, bus.produce_blob
 
 
 def main(argv=None):
@@ -82,7 +83,7 @@ def main(argv=None):
                          "infinite loop, so it never faces stream end)")
     args = ap.parse_args(argv)
 
-    send = _build_sink(args)
+    send, send_blob = _build_sink(args)
     distribution = args.distribution
     if args.variant == "simple":
         key = distribution.lower().replace("-", "_")
@@ -108,16 +109,22 @@ def main(argv=None):
         # ~0.1 s native)
         iv = vals.astype(np.int64)
         fmt = format_tuples_native(ids, iv)
-        if fmt is not None:
+        if fmt is not None and send_blob is not None:
+            # zero-copy plane: blob + offsets go straight into RecordBatch
+            # assembly (kafkalite send_blob) — no per-record bytes objects
+            send_blob(args.topic, *fmt)
+        elif fmt is not None:
             blob, offs = fmt
             ot = offs.tolist()
-            lines = [blob[ot[i] : ot[i + 1]] for i in range(n)]
+            send(args.topic, [blob[ot[i] : ot[i + 1]] for i in range(n)])
         else:
-            lines = [
-                ",".join(map(str, (i, *row)))
-                for i, row in zip(ids.tolist(), iv.tolist())
-            ]
-        send(args.topic, lines)
+            send(
+                args.topic,
+                [
+                    ",".join(map(str, (i, *row)))
+                    for i, row in zip(ids.tolist(), iv.tolist())
+                ],
+            )
         record_id += n
         while args.query_threshold > 0 and record_id >= next_trigger:
             # barrier = the threshold-crossing id, NOT the batch-end id: the
