@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Round-4 hardware measurement sequence — run when the TPU link is up.
+# Each step is independently time-bounded and failure-tolerant so one
+# flaky stage (or a link drop mid-way) still leaves the others' artifacts.
+#
+#   bash scripts/tpu_round4_measure.sh [out_dir]
+#
+# Steps:
+#  1. north-star bench, rank cascade ON (the default)       -> bench_rank_on.json
+#  2. north-star bench, rank cascade OFF (value cascade A/B) -> bench_rank_off.json
+#  3. kernel-level rank A/B grid                             -> artifacts/rank_cascade_ab.json
+#  4. e2e transport 2D+8D, overlap policy                    -> artifacts/e2e_transport.json
+#  5. sliding north star                                     -> artifacts/sliding_northstar.json
+cd "$(dirname "$0")/.."
+OUT=${1:-artifacts/r4_measure}
+mkdir -p "$OUT"
+export BENCH_COMPILE_CACHE=${BENCH_COMPILE_CACHE:-$PWD/.jax_cache}
+export SKYLINE_COMPILE_CACHE=$BENCH_COMPILE_CACHE
+
+step() {
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/measure.log"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  local rc=$?
+  echo "$name rc=$rc" | tee -a "$OUT/measure.log"
+  tail -c 2000 "$OUT/$name.out" | tee -a "$OUT/measure.log"
+  return 0
+}
+
+step bench_rank_on 3000 env SKYLINE_RANK_CASCADE=1 python bench.py
+cp "$OUT/bench_rank_on.out" "$OUT/bench_rank_on.json" 2>/dev/null || true
+step bench_rank_off 3000 env SKYLINE_RANK_CASCADE=0 python bench.py
+cp "$OUT/bench_rank_off.out" "$OUT/bench_rank_off.json" 2>/dev/null || true
+step rank_ab 1800 python benchmarks/rank_cascade.py
+step e2e 2400 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8
+step sliding 2400 python benchmarks/sliding_northstar.py
+echo "=== done ($(date +%H:%M:%S)) ===" | tee -a "$OUT/measure.log"
