@@ -27,10 +27,16 @@ step() {
   return 0
 }
 
+json_of() {  # keep only a complete final JSON line; no artifact otherwise
+  grep '^{' "$OUT/$1.out" 2>/dev/null | tail -1 > "$OUT/$1.json.tmp"
+  if [ -s "$OUT/$1.json.tmp" ]; then mv "$OUT/$1.json.tmp" "$OUT/$1.json"
+  else rm -f "$OUT/$1.json.tmp"; fi
+}
+
 step bench_rank_on 3000 env SKYLINE_RANK_CASCADE=1 python bench.py
-cp "$OUT/bench_rank_on.out" "$OUT/bench_rank_on.json" 2>/dev/null || true
+json_of bench_rank_on
 step bench_rank_off 3000 env SKYLINE_RANK_CASCADE=0 python bench.py
-cp "$OUT/bench_rank_off.out" "$OUT/bench_rank_off.json" 2>/dev/null || true
+json_of bench_rank_off
 step rank_ab 1800 python benchmarks/rank_cascade.py
 step e2e 2400 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8
 step sliding 2400 python benchmarks/sliding_northstar.py

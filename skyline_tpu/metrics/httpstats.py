@@ -1,13 +1,17 @@
-"""Live observability endpoint — the Flink Web UI role, minimally.
+"""Live observability endpoint — the Flink Web UI role.
 
 The reference operator watches Flink's Web UI on :8081
 (/root/reference/docker-setup/docker-compose.yml:26) while a job runs. The
 TPU worker's equivalent surface is ``SkylineEngine.stats()`` — this module
 serves it (plus any caller-supplied counters) as JSON over a stdlib
-``http.server`` thread, so ``curl localhost:<port>/stats`` works during a
-``deploy/launch.py`` run.
+``http.server`` thread, plus a self-contained human-facing dashboard, so
+both ``curl localhost:<port>/stats`` and a browser on the root URL work
+during a ``deploy/launch.py`` run.
 
 Endpoints:
+  GET /         human dashboard (single self-contained HTML page polling
+                /stats — headline counters + per-partition load bars; the
+                Flink-Web-UI role for an operator's browser)
   GET /stats    full stats JSON (engine counters, partitions, worker I/O)
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
@@ -18,9 +22,65 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+_DASHBOARD = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpu-skyline worker</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#14171c;color:#e6e6e6}
+ h1{font-size:1.2rem;font-weight:600} .muted{color:#8a93a3}
+ .tiles{display:flex;gap:1rem;flex-wrap:wrap;margin:1rem 0}
+ .tile{background:#1e232b;border-radius:8px;padding:.8rem 1.1rem;min-width:9rem}
+ .tile .v{font-size:1.5rem;font-variant-numeric:tabular-nums}
+ .tile .k{font-size:.75rem;color:#8a93a3;text-transform:uppercase;letter-spacing:.05em}
+ table{border-collapse:collapse;margin-top:.6rem;font-variant-numeric:tabular-nums}
+ td,th{padding:.25rem .7rem;text-align:right;font-size:.85rem}
+ th{color:#8a93a3;font-weight:500} td:first-child,th:first-child{text-align:left}
+ .bar{height:.55rem;border-radius:3px;background:#3fb68b;min-width:2px;display:inline-block}
+ #err{color:#e07676}
+</style></head><body>
+<h1>tpu-skyline worker <span class="muted" id="ts"></span></h1>
+<div class="tiles" id="tiles"></div>
+<table id="parts"></table>
+<div id="err"></div>
+<script>
+const fmt = n => typeof n === "number" ? n.toLocaleString("en-US") : n;
+async function tick() {
+  try {
+    const resp = await fetch("/stats");
+    const s = await resp.json();
+    if (!resp.ok || s.error) throw new Error(s.error || resp.status);
+    document.getElementById("err").textContent = "";
+    document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+    const tiles = [
+      ["records in", s.records_in], ["results", s.results_emitted],
+      ["in-flight queries", s.inflight_queries],
+      ["pending rows", s.pending_flush_rows],
+      ["dropped", s.dropped], ["prefiltered", s.prefiltered],
+      ["device ms", s.processing_ms && Math.round(s.processing_ms)],
+      ["meshed", s.meshed],
+      ["slides closed", s.slides_closed],
+    ].filter(([, v]) => v !== undefined);
+    document.getElementById("tiles").innerHTML = tiles.map(
+      ([k, v]) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`
+    ).join("");
+    const p = s.partitions || {};
+    const seen = p.records_seen || [], ids = p.max_seen_id || [],
+          sky = p.skyline_counts;
+    const mx = Math.max(1, ...seen);
+    let rows = `<tr><th>partition</th><th>records</th><th style="text-align:left">load</th><th>max id</th>${sky ? "<th>skyline</th>" : ""}</tr>`;
+    for (let i = 0; i < seen.length; i++) {
+      rows += `<tr><td>p${i}</td><td>${fmt(seen[i])}</td>` +
+        `<td style="text-align:left"><span class="bar" style="width:${Math.round(140 * seen[i] / mx)}px"></span></td>` +
+        `<td>${fmt(ids[i])}</td>${sky ? `<td>${fmt(sky[i])}</td>` : ""}</tr>`;
+    }
+    document.getElementById("parts").innerHTML = rows;
+  } catch (e) { document.getElementById("err").textContent = "stats fetch failed: " + e; }
+}
+tick(); setInterval(tick, 1000);
+</script></body></html>"""
+
 
 class StatsServer:
-    """Background JSON stats server.
+    """Background stats server: JSON (/stats, /healthz) + dashboard (/).
 
     ``callback`` is invoked per /stats request and must return a
     JSON-serializable dict; exceptions become a 500 with the error message
@@ -34,11 +94,20 @@ class StatsServer:
             def do_GET(handler):  # noqa: N805 — http.server API
                 if handler.path == "/healthz":
                     handler._reply(200, {"ok": True})
-                elif handler.path in ("/", "/stats"):
+                elif handler.path == "/stats":
                     try:
                         handler._reply(200, callback())
                     except Exception as e:  # pragma: no cover - defensive
                         handler._reply(500, {"error": str(e)})
+                elif handler.path in ("/", "/ui"):
+                    body = _DASHBOARD.encode()
+                    handler.send_response(200)
+                    handler.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    handler.send_header("Content-Length", str(len(body)))
+                    handler.end_headers()
+                    handler.wfile.write(body)
                 else:
                     handler._reply(404, {"error": "not found"})
 

@@ -193,3 +193,27 @@ def test_max_drain_polls_cli_flag():
     assert cfg.max_drain_polls == 7
     with pytest.raises(ValueError):
         JobConfig(max_drain_polls=0)
+
+
+def test_stats_dashboard_served():
+    """The root URL serves the human dashboard (Flink-Web-UI role); /stats
+    stays JSON."""
+    import json
+    import urllib.request
+
+    from skyline_tpu.metrics.httpstats import StatsServer
+
+    srv = StatsServer(lambda: {"records_in": 7, "partitions": {}}, 0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/"
+        ) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/html")
+            assert "tpu-skyline worker" in body and "/stats" in body
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats"
+        ) as r:
+            assert json.load(r)["records_in"] == 7
+    finally:
+        srv.close()
