@@ -27,10 +27,14 @@ step() {
   return 0
 }
 
-json_of() {  # keep only a complete final JSON line; no artifact otherwise
+json_of() {  # keep only a complete, parseable final JSON line
   grep '^{' "$OUT/$1.out" 2>/dev/null | tail -1 > "$OUT/$1.json.tmp"
-  if [ -s "$OUT/$1.json.tmp" ]; then mv "$OUT/$1.json.tmp" "$OUT/$1.json"
-  else rm -f "$OUT/$1.json.tmp"; fi
+  if python -c "import json,sys; json.load(open(sys.argv[1]))" \
+      "$OUT/$1.json.tmp" 2>/dev/null; then
+    mv "$OUT/$1.json.tmp" "$OUT/$1.json"
+  else
+    rm -f "$OUT/$1.json.tmp"
+  fi
 }
 
 step bench_rank_on 3000 env SKYLINE_RANK_CASCADE=1 python bench.py

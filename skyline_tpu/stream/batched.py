@@ -349,7 +349,7 @@ class PartitionSet:
             return False
         if self.flush_policy == "overlap":
             if self.pending_rows_total >= self.overlap_rows:
-                self.flush_all()
+                self.flush_all(tighten=False)
                 return True
             return False
         if int(self._pending_rows.max()) >= self.buffer_size:
@@ -400,19 +400,27 @@ class PartitionSet:
                 batch[p], bvalid[p], widths[p] = self._pad_block(part_rows, B)
         return batch, bvalid, widths
 
-    def flush_all(self) -> None:
+    def flush_all(self, tighten: bool = True) -> None:
         """Merge every partition's pending rows into its running skyline:
         one batched device launch per round (incremental policy), or
         append-only SFS rounds over the sum-sorted pending windows
         (lazy/overlap policies — host pending lists first, then the device
         accumulation window; a restored checkpoint can leave host pendings
-        on a device-ingest set)."""
+        on a device-ingest set).
+
+        ``tighten=False`` (overlap auto-flushes) runs the device flush
+        SYNC-FREE: had-old detection and buckets come from the host upper
+        bounds, the cleanup's exact old counts stay a device array, and the
+        trailing bound-tightening sync is skipped — the host never blocks
+        on the device mid-stream, which is the point of the overlap policy.
+        Query-time flushes keep the default (exact buckets for the global
+        merge)."""
         total = int(self._pending_rows.sum())
         if self.flush_policy in ("lazy", "overlap"):
             if total:
                 self._flush_lazy()
             if self._dev_rows:
-                self._flush_lazy_device()
+                self._flush_lazy_device(tighten)
             return
         if total == 0:
             return
@@ -668,17 +676,23 @@ class PartitionSet:
             self._count_ub[p] = ub_p
         return self._restack_skies(new_skies, new_counts)
 
-    def _sfs_sequential_dev(self, ws, bounds: np.ndarray, rank=None):
+    def _sfs_sequential_dev(
+        self, ws, bounds: np.ndarray, rank=None, tighten=True
+    ):
         """Device-window twin of ``_sfs_sequential``: blocks are sliced out
         of the sorted window ``ws`` at host-tracked offsets instead of
         assembled from host rows — same probe/escalation, lag-2 tightening,
         and on-demand capacity growth. ``rank``: (ws_ranks, sorted_dims)
-        switches the rounds to the rank cascade. Returns the device counts
-        vector."""
+        switches the rounds to the rank cascade. ``tighten=False`` seeds
+        the per-partition bounds from the host upper bounds instead of a
+        count sync (sync-free overlap flushes; bounds-only use). Returns
+        the device counts vector."""
         # fresh set: counts are provably zero, skip the sync (see
         # _sfs_sequential)
         if not int(self._count_ub.max()):
             counts_host = np.zeros(self.num_partitions, dtype=np.int64)
+        elif not tighten:
+            counts_host = self._count_ub.copy()
         else:
             counts_host = self.sky_counts().astype(np.int64)
         widths = np.diff(bounds)
@@ -687,6 +701,13 @@ class PartitionSet:
         # block from its validity mask) — cap every device block there
         B_max = min(self._seq_block_size(int(widths.max())), dw.SORT_TAIL)
         need0 = int(counts_host.max()) + B_max
+        if need0 > self._cap and not tighten:
+            # growth pressure under loose bounds: tighten with one exact
+            # sync ONLY now (the bounds otherwise ratchet up with rows
+            # streamed and capacity would track the stream, not the
+            # skyline — the same on-demand fallback _sfs_vmapped_dev uses)
+            counts_host = self.sky_counts().astype(np.int64)
+            need0 = int(counts_host.max()) + B_max
         if need0 > self._cap:
             self._grow_cap(_next_pow2(need0))
 
@@ -775,7 +796,13 @@ class PartitionSet:
             counts = self._sfs_sequential(rows)
         else:
             counts = self._sfs_vmapped(rows, max_rows)
-        self._finish_lazy_flush(counts, had_old, old_counts, t0)
+        self._finish_lazy_flush(
+            counts,
+            had_old,
+            old_counts,
+            int(old_counts.max()) if had_old else 0,
+            t0,
+        )
 
     def _check_had_old(self):
         """Non-empty initial state needs exact old counts for the final
@@ -787,16 +814,20 @@ class PartitionSet:
         return had_old, old_counts
 
     def _finish_lazy_flush(
-        self, counts, had_old, old_counts, t0, rank=None
+        self, counts, had_old, old_counts, old_max, t0, rank=None,
+        tighten=True,
     ) -> None:
         """Shared tail of the lazy flush paths: old-vs-new cleanup,
-        validity/caches, one bound-tightening sync. ``rank``: (ws_ranks,
-        sorted_dims) from the rank-cascade device flush — the cleanup then
-        compares in rank space (old prefixes are universe members)."""
+        validity/caches, bound tightening. ``old_counts``: exact
+        per-partition pre-flush counts, host or device array (host required
+        under a mesh for sharding); ``old_max``: a host bound on their max
+        (buckets only). ``rank``: (ws_ranks, sorted_dims) from the
+        rank-cascade device flush — the cleanup then compares in rank space
+        (old prefixes are universe members). ``tighten=False`` skips the
+        trailing count sync (overlap auto-flushes; the next flush's buckets
+        then run off the lag-2 upper bounds)."""
         if had_old:
-            old_active = min(
-                self._cap, _active_bucket(max(int(old_counts.max()), 1))
-            )
+            old_active = min(self._cap, _active_bucket(max(old_max, 1)))
             active = min(
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
@@ -816,7 +847,7 @@ class PartitionSet:
                         old_active, active,
                     )
                     self.sky, counts = cl(
-                        self.sky, counts, self._put(old_counts)
+                        self.sky, counts, self._put(np.asarray(old_counts))
                     )
                 else:
                     self.sky, counts = sfs_cleanup(
@@ -830,20 +861,29 @@ class PartitionSet:
         self.sky_valid = jnp.arange(self._cap)[None, :] < counts[:, None]
         self._counts_cache = None
         self._host_cache = None
-        # tighten the upper bounds with ONE sync: the caller's next step is
-        # almost always the global merge, whose active bucket comes from
-        # _count_ub — loose row-count bounds (vs true survivor counts) can
-        # double its pairwise work for nothing
-        self.sky_counts()
+        if tighten:
+            # tighten the upper bounds with ONE sync: the caller's next
+            # step is almost always the global merge, whose active bucket
+            # comes from _count_ub — loose row-count bounds (vs true
+            # survivor counts) can double its pairwise work for nothing
+            self.sky_counts()
         self.processing_ns += time.perf_counter_ns() - t0
 
-    def _flush_lazy_device(self) -> None:
+    def _flush_lazy_device(self, tighten: bool = True) -> None:
         """Lazy/overlap flush over the device accumulation window: one
         (pid, sum) sort + segment-bounds launch, then SFS rounds slicing
         blocks straight from the sorted buffer (stream/device_window.py) —
-        no host routing, assembly, or per-block upload."""
+        no host routing, assembly, or per-block upload. Barrier/metrics
+        bookkeeping is NOT synced here (flushing doesn't need it; triggers
+        sync it on demand).
+
+        ``tighten=False``: no count syncs — had-old detection and every
+        bucket come from the host upper bounds (conservative is correct:
+        rows past the true counts are +inf/invalid), the cleanup's exact
+        per-partition old counts stay the pre-flush DEVICE count vector,
+        and the trailing tighten sync is skipped. Only the segment-bounds
+        transfer (host loop control) touches the device."""
         t0 = time.perf_counter_ns()
-        self.sync_ingest_bookkeeping()
         n = self._dev_rows
         n_bucket = _next_pow2(n)
         with self.tracer.phase("flush/sort"):
@@ -857,7 +897,16 @@ class PartitionSet:
             )
             bounds = np.asarray(bounds_dev, dtype=np.int64)
         self._dev_rows = 0
-        had_old, old_counts = self._check_had_old()
+        if tighten:
+            had_old, old_counts = self._check_had_old()
+            old_max = int(old_counts.max()) if had_old else 0
+        else:
+            had_old = bool((self._count_ub > 0).any())
+            # exact per-partition old counts WITHOUT a sync: the pre-flush
+            # device count vector (cleanup classifies old-vs-new rows by
+            # these, so exactness matters; buckets below only need bounds)
+            old_counts = self._count_dev if had_old else None
+            old_max = int(self._count_ub.max()) if had_old else 0
         # rank-cascade mode: rank the window (+ live sky prefixes, which
         # must share the rank universe) once per flush; the rounds then
         # compare dense ranks instead of values (2 VPU ops/dim + one
@@ -865,7 +914,7 @@ class PartitionSet:
         rank = None
         if dw.rank_flush_enabled():
             active_old = (
-                min(self._cap, _active_bucket(max(int(old_counts.max()), 1)))
+                min(self._cap, _active_bucket(max(old_max, 1)))
                 if had_old
                 else 0
             )
@@ -887,10 +936,12 @@ class PartitionSet:
         total_rows = int(widths.sum())
         # same skew heuristic as the host path (see _flush_lazy)
         if self.num_partitions * max_rows > 2 * total_rows:
-            counts = self._sfs_sequential_dev(ws, bounds, rank)
+            counts = self._sfs_sequential_dev(ws, bounds, rank, tighten)
         else:
             counts = self._sfs_vmapped_dev(ws, bounds, max_rows, rank)
-        self._finish_lazy_flush(counts, had_old, old_counts, t0, rank)
+        self._finish_lazy_flush(
+            counts, had_old, old_counts, old_max, t0, rank, tighten
+        )
 
     def _sfs_vmapped_dev(
         self, ws, bounds: np.ndarray, max_rows: int, rank=None
