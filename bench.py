@@ -37,7 +37,7 @@ BENCH_BUFFER (flush threshold, default 8192), BENCH_INITIAL_CAP (skyline
 buffer pre-size per partition, default 65536 — lower it on small devices),
 BENCH_COMPILE_CACHE (persistent XLA cache dir, default ./.jax_cache),
 BENCH_PROBE_TIMEOUT (s, default 150), BENCH_PROBE_ATTEMPTS (default 2),
-BENCH_PROBE_BACKOFF (s, default 20), BENCH_CHILD_TIMEOUT (s, default 2400),
+BENCH_PROBE_BACKOFF (s, default 20), BENCH_CHILD_TIMEOUT (s, default 3000),
 BENCH_TPU_ATTEMPTS (default 2), BENCH_CPU_N (CPU-fallback window size,
 default 131072), BENCH_FORCE_CPU=1 (skip the TPU path entirely).
 
@@ -138,8 +138,12 @@ def child_main(backend: str) -> None:
         # lazy = sum-sorted append-only SFS at query time: a fraction of the
         # incremental policy's dominance work for the tumbling
         # window-then-query pattern (see stream/batched.py). Set
-        # BENCH_FLUSH_POLICY=incremental to measure the streaming cadence.
+        # BENCH_FLUSH_POLICY=incremental to measure the streaming cadence,
+        # =overlap for the transport-style chunked flushes.
         flush_policy=os.environ.get("BENCH_FLUSH_POLICY", "lazy"),
+        # device ingest: pre-size the accumulation window to the known
+        # window size (skips per-run growth reallocs/executables)
+        window_capacity=n,
     )
     rng = np.random.default_rng(0)
     ids = np.arange(n, dtype=np.int64)
@@ -280,7 +284,7 @@ def main() -> None:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
     probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2))
     probe_backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 20))
-    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 2400))
+    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 3000))
     tpu_attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
     # a user-pinned JAX_PLATFORMS=cpu is the conventional JAX override and
     # implies the CPU path, same as BENCH_FORCE_CPU=1

@@ -88,6 +88,7 @@ class PartitionSet:
         flush_policy: str = "incremental",
         route: tuple[str, float] | None = None,
         overlap_rows: int = 262144,
+        window_capacity: int = 0,
     ):
         """``initial_capacity``: pre-size the per-partition skyline buffers
         (rounded up to the power-of-two bucket). Capacity normally grows on
@@ -147,6 +148,7 @@ class PartitionSet:
             )
         self.flush_policy = flush_policy
         self._route = route
+        self.window_capacity = window_capacity
         # device-ingest accumulation state (route is not None):
         self._dev_window = None  # (dev_cap, d) +inf-padded row buffer
         self._dev_pids = None  # (dev_cap,) int32, sentinel num_partitions
@@ -281,7 +283,14 @@ class PartitionSet:
         offset is row-granular while ``need`` includes the incoming chunk's
         padded bucket, so the dynamic_update_slice never clamps."""
         if self._dev_window is None:
-            cap = max(_next_pow2(need), 131072)
+            # window_capacity hint: pre-size so a full expected window
+            # (plus the final chunk's padded bucket) never reallocates
+            hint = (
+                _next_pow2(self.window_capacity + _CHUNK_BUCKET_MAX)
+                if self.window_capacity
+                else 0
+            )
+            cap = max(_next_pow2(need), hint, 131072)
             self._dev_window = jnp.full(
                 (cap, self.dims), jnp.inf, dtype=jnp.float32
             )

@@ -72,6 +72,10 @@ class EngineConfig:
     flush_policy: str = "incremental"
     # rows accumulated between automatic flushes under flush_policy="overlap"
     overlap_rows: int = 262144
+    # expected rows per window (0 = unknown): pre-sizes the device-ingest
+    # accumulation buffer so steady-state windows never grow it (each
+    # growth is a reallocation + a fresh ingest executable per capacity)
+    window_capacity: int = 0
     # "auto": route + sort + SFS block slicing on device when single-device
     # lazy/overlap without grid_prefilter (stream/device_window.py); "host":
     # numpy routing in process_records; "device": force the device path
@@ -184,6 +188,7 @@ class SkylineEngine:
             flush_policy=config.flush_policy,
             route=(config.algo, config.domain_max) if use_device else None,
             overlap_rows=config.overlap_rows,
+            window_capacity=config.window_capacity,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
