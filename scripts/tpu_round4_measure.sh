@@ -46,4 +46,5 @@ json_of bench_overlap
 step rank_ab 1800 python benchmarks/rank_cascade.py
 step e2e 2400 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8
 step sliding 2400 python benchmarks/sliding_northstar.py
+step refgrid 3600 python benchmarks/reference_grid.py
 echo "=== done ($(date +%H:%M:%S)) ===" | tee -a "$OUT/measure.log"
