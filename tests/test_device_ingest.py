@@ -214,3 +214,22 @@ def test_rank_flush_off_by_env(rng, monkeypatch):
     from skyline_tpu.stream import device_window as dw
 
     assert not dw.rank_flush_enabled()
+
+
+def test_window_capacity_presizes_accumulation_buffer(rng):
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-dim", dims=2, domain_max=1000.0,
+        flush_policy="lazy", ingest="device", window_capacity=200_000,
+    )
+    eng = SkylineEngine(cfg)
+    x = rng.uniform(0, 1000, (1000, 2)).astype(np.float32)
+    eng.process_records(np.arange(1000), x)
+    cap0 = eng.pset._dev_cap
+    assert cap0 >= 200_000  # pre-sized, not the 131072 floor
+    # a full expected window never reallocates
+    for i in range(1, 5):
+        eng.process_records(
+            np.arange(i * 1000, (i + 1) * 1000),
+            rng.uniform(0, 1000, (1000, 2)).astype(np.float32),
+        )
+    assert eng.pset._dev_cap == cap0
