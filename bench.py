@@ -253,11 +253,8 @@ def _attach_last_tpu_run(result: dict) -> None:
     artifact) so a tunnel outage at bench time doesn't hide the real
     number. Never raises — the primary result line must survive any
     artifact corruption."""
-    tpu_artifact = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "artifacts",
-        "bench_tpu.json",
-    )
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tpu_artifact = os.path.join(repo, "artifacts", "bench_tpu.json")
     try:
         with open(tpu_artifact) as f:
             last = json.load(f)
@@ -270,10 +267,29 @@ def _attach_last_tpu_run(result: dict) -> None:
                 "vs_baseline",
                 "p50_window_latency_ms",
                 "phase_breakdown_ms",
+                # which measurement leg produced the recorded number (the
+                # round-5 measure script promotes the best of default /
+                # rank-on / overlap legs, which differ in config)
+                "measure_leg",
+                "flush_policy",
             )
             if k in last
         }
         result["last_recorded_tpu_artifact"] = "artifacts/bench_tpu.json"
+        # provenance: when was that artifact last committed, so a stale
+        # recorded run can't be mistaken for a current measurement
+        try:
+            r = subprocess.run(
+                ["git", "log", "-1", "--format=%h %cI",
+                 "--", "artifacts/bench_tpu.json"],
+                capture_output=True, text=True, timeout=20, cwd=repo,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                commit, _, date = r.stdout.strip().partition(" ")
+                result["last_recorded_tpu_run"]["artifact_commit"] = commit
+                result["last_recorded_tpu_run"]["artifact_committed_at"] = date
+        except (OSError, subprocess.SubprocessError):
+            pass
     except (OSError, ValueError):
         pass
 

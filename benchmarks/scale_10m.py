@@ -1,0 +1,182 @@
+"""Beyond-reference scale leg: 8D x 10M tumbling window + oracle spot-check.
+
+The reference proved 2D/3D linear scaling to 10M records
+(/root/reference pdf §5.2, graph_paper_figures.py); our matrix already has
+QoS-4D/10M. This leg pushes the hardest axis combination — 8 dimensions at
+10M records — through the full engine path (routing -> device window ->
+SFS flush -> barrier -> global merge), exercising capacity growth and the
+ladder union cap at 10x the north-star window.
+
+Correctness at this scale can't use the O(n^2) host oracle, so the result
+is verified with two subsampled invariants that together pin the answer:
+
+  1. antichain — no reported skyline point dominates another (checked on
+     up to --antichain-cap points of the reported set, blockwise numpy);
+  2. subsampled completeness — every point in a random --sample of the
+     window is either in the reported set or strictly dominated by a
+     reported point (if the engine had dropped a true skyline point p,
+     p is dominated by nothing, so any sample containing p fails);
+  3. membership — every reported point occurs in the window (byte-exact).
+
+Writes one JSON line + artifacts/scale_10m.json, and appends a
+baseline_matrix-schema row to --matrix (default artifacts/baseline_matrix.jsonl).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import one_window
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.workload.generators import generate
+
+
+def _dominates_block(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(len(a), len(b)) bool: a[i] strictly dominates b[j] (min-better)."""
+    le = np.all(a[:, None, :] <= b[None, :, :], axis=2)
+    lt = np.any(a[:, None, :] < b[None, :, :], axis=2)
+    return le & lt
+
+
+def check_antichain(sky: np.ndarray, cap: int, rng) -> dict:
+    """No point of the (sub)set dominates another."""
+    s = sky if sky.shape[0] <= cap else sky[rng.choice(sky.shape[0], cap, replace=False)]
+    bad = 0
+    B = 2048
+    for i in range(0, s.shape[0], B):
+        for j in range(0, s.shape[0], B):
+            d = _dominates_block(s[i : i + B], s[j : j + B])
+            bad += int(d.sum())
+    return {"checked": int(s.shape[0]), "violations": bad}
+
+
+def check_completeness(x: np.ndarray, sky: np.ndarray, sample: int, rng) -> dict:
+    """Every sampled window point is in the skyline or dominated by it.
+
+    Active-set shrinking: most sampled points are dominated by the first
+    few skyline blocks, so the inner compare runs on a fast-shrinking
+    remainder instead of the full sample every block.
+    """
+    idx = rng.choice(x.shape[0], min(sample, x.shape[0]), replace=False)
+    pts = x[idx]
+    # drop sampled points that ARE reported skyline points (byte-exact)
+    sky_v = np.ascontiguousarray(sky.astype(np.float32)).view(
+        [("", np.float32)] * sky.shape[1]
+    ).ravel()
+    pts_v = np.ascontiguousarray(pts.astype(np.float32)).view(
+        [("", np.float32)] * pts.shape[1]
+    ).ravel()
+    active = pts[~np.isin(pts_v, sky_v)]
+    # block BOTH axes: the broadcast temporaries stay (2048 x 4096 x d)
+    # ~tens of MB instead of (sample x 4096 x d) gigabytes on block one
+    B_SKY, B_ACT = 4096, 2048
+    for j in range(0, sky.shape[0], B_SKY):
+        if active.shape[0] == 0:
+            break
+        blk = sky[j : j + B_SKY]
+        keep_parts = []
+        for i in range(0, active.shape[0], B_ACT):
+            a = active[i : i + B_ACT]
+            le = np.all(a[:, None, :] >= blk[None, :, :], axis=2)
+            lt = np.any(a[:, None, :] > blk[None, :, :], axis=2)
+            keep_parts.append(a[~(le & lt).any(axis=1)])
+        active = np.concatenate(keep_parts) if keep_parts else active[:0]
+    return {"sampled": int(len(idx)), "undominated_nonskyline": int(active.shape[0])}
+
+
+def check_membership(x: np.ndarray, sky: np.ndarray) -> dict:
+    win_v = np.ascontiguousarray(x.astype(np.float32)).view(
+        [("", np.float32)] * x.shape[1]
+    ).ravel()
+    sky_v = np.ascontiguousarray(sky.astype(np.float32)).view(
+        [("", np.float32)] * sky.shape[1]
+    ).ravel()
+    missing = int((~np.isin(sky_v, win_v)).sum())
+    return {"reported": int(sky.shape[0]), "not_in_window": missing}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--dims", type=int, default=8)
+    ap.add_argument("--dist", default="uniform")
+    ap.add_argument("--algo", default="mr-dim")
+    ap.add_argument("--policy", default="lazy",
+                    choices=("incremental", "lazy", "overlap"))
+    ap.add_argument("--sample", type=int, default=50_000)
+    ap.add_argument("--antichain-cap", type=int, default=30_000)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--out", default="artifacts/scale_10m.json")
+    ap.add_argument("--matrix", default="artifacts/baseline_matrix.jsonl")
+    a = ap.parse_args(argv)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from skyline_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    rng = np.random.default_rng(0)
+    cfg = EngineConfig(parallelism=4, algo=a.algo, dims=a.dims,
+                       domain_max=10000.0, buffer_size=8192,
+                       flush_policy=a.policy, emit_skyline_points=True)
+    x = generate(a.dist, rng, a.n, a.dims, 0, 10000)
+    ids = np.arange(a.n, dtype=np.int64)
+    warm_s = 0.0
+    if not a.no_warmup:
+        warm_s, _ = one_window(cfg, ids, x)
+    dt, r = one_window(cfg, ids, x)
+    sky = np.asarray(r["skyline_points"], dtype=np.float64)
+
+    t0 = time.perf_counter()
+    crng = np.random.default_rng(1)
+    checks = {
+        "antichain": check_antichain(sky, a.antichain_cap, crng),
+        "completeness": check_completeness(x, sky, a.sample, crng),
+        "membership": check_membership(x, sky),
+    }
+    ok = (
+        checks["antichain"]["violations"] == 0
+        and checks["completeness"]["undominated_nonskyline"] == 0
+        and checks["membership"]["not_in_window"] == 0
+    )
+    out = {
+        "config": f"{a.dims}d_{a.dist}_{a.algo.replace('-', '')}_{a.n // 1_000_000}m",
+        "n": a.n,
+        "dims": a.dims,
+        "algo": a.algo,
+        "policy": a.policy,
+        "backend": jax.default_backend(),
+        "tuples_per_sec": round(a.n / dt, 1),
+        "window_s": round(dt, 2),
+        "warmup_window_s": round(warm_s, 2),
+        "skyline_size": r["skyline_size"],
+        "optimality": r["optimality"],
+        "oracle_check": {**checks, "ok": ok, "check_s": round(time.perf_counter() - t0, 1)},
+    }
+    os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(out, f, indent=1)
+    matrix_row = {k: out[k] for k in ("config", "n", "dims", "algo",
+                                      "tuples_per_sec", "window_s",
+                                      "warmup_window_s", "skyline_size",
+                                      "optimality")}
+    matrix_row["oracle_ok"] = ok
+    with open(a.matrix, "a") as f:
+        f.write(json.dumps(matrix_row) + "\n")
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

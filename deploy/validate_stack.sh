@@ -20,6 +20,7 @@ if [ -z "$RUNTIME" ]; then
   cat > artifacts/container_stack.json <<EOF
 {
  "status": "blocked",
+ "probed_at": "$(date -u +%FT%TZ)",
  "probe": {"docker": null, "podman": null, "nerdctl": null},
  "blocker": "no container runtime in this image and no package egress to install one; deploy/docker-compose.yml is untested here. Bare-metal equivalent of the same topology (kafkalite broker + worker + collector + producer as separate OS processes) runs via deploy/launch.py and is exercised by benchmarks/e2e_transport.py (artifacts/e2e_transport.json).",
  "how_to_run": "on a docker host: deploy/validate_stack.sh"
@@ -50,8 +51,12 @@ import csv, json
 rows = list(csv.reader(open("deploy/validate_logs/results.csv")))
 assert len(rows) >= 2, "no result row captured"
 row = dict(zip(rows[0], rows[1]))
+import datetime
 json.dump(
-    {"status": "ran", "result_row": row, "logs": "deploy/validate_logs/"},
+    {"status": "ran",
+     "probed_at": datetime.datetime.now(datetime.timezone.utc)
+         .strftime("%Y-%m-%dT%H:%M:%SZ"),
+     "result_row": row, "logs": "deploy/validate_logs/"},
     open("artifacts/container_stack.json", "w"), indent=1,
 )
 print("container stack validated:", row)

@@ -63,6 +63,11 @@ def main() -> int:
             "and skip cleanly; run them on any machine with kafka-python "
             "or point SKYLINE_INTEROP_BOOTSTRAP at a real broker."
         )
+    import datetime
+
+    report["probed_at"] = datetime.datetime.now(
+        datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
     out = os.path.join(REPO, "artifacts", "kafka_interop.json")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 watcher: poll for TPU link recovery; on recovery, run the full
+# round-5 measurement sequence, commit the artifacts, and exit. Touches
+# /tmp/tpu_up on recovery so an interactive session can notice cheaply.
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+echo "watch5 start $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+while true; do
+  if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'; print(jax.devices())" >> artifacts/tpu_watch.log 2>&1; then
+    echo "TPU BACK $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+    touch /tmp/tpu_up
+    bash scripts/tpu_round5_measure.sh artifacts/r5_measure
+    echo "r5 measure finished $(date -u +%FT%TZ)" >> artifacts/tpu_watch.log
+    git add artifacts/ 2>/dev/null
+    # pathspec commit: only artifacts/ — never sweep unrelated staged work
+    git commit -m "Round-5 TPU measurement artifacts (auto-committed on link recovery)" -- artifacts/ >> artifacts/tpu_watch.log 2>&1
+    exit 0
+  fi
+  sleep 180
+done

@@ -35,6 +35,7 @@ class SkylineWorker:
         slide: int = 0,
         emit_per_slide: bool = False,
         max_drain_polls: int = 256,
+        tracer=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``stats_port``: serve
@@ -44,9 +45,18 @@ class SkylineWorker:
         (``stream.sliding_engine``), same transport and result planes.
         ``max_drain_polls``: cap on trigger-pending data re-polls per step
         (see ``step``); at the 65536-row default poll size the default cap
-        drains up to ~16.7M rows before a trigger is applied anyway."""
+        drains up to ~16.7M rows before a trigger is applied anyway.
+        ``tracer``: optional ``metrics.tracing.Tracer``; by default the
+        worker traces its own loop (transport poll / parse / engine phases)
+        with ``sync_device=False`` so the breakdown is observable in
+        ``/stats`` without perturbing the async device pipeline."""
+        from skyline_tpu.metrics.tracing import Tracer
+
         self.bus = bus
         self.max_drain_polls = max_drain_polls
+        self.tracer = tracer if tracer is not None else Tracer(sync_device=False)
+        self._phase_snapshot_ms: dict[str, float] = {}
+        self._last_phase_report_s = 0.0
         if window_size:
             from skyline_tpu.stream.sliding_engine import SlidingEngine
 
@@ -56,9 +66,10 @@ class SkylineWorker:
                 slide=slide,
                 mesh=mesh,
                 emit_per_slide=emit_per_slide,
+                tracer=self.tracer,
             )
         else:
-            self.engine = SkylineEngine(config, mesh=mesh)
+            self.engine = SkylineEngine(config, mesh=mesh, tracer=self.tracer)
         self.output_topic = output_topic
         self._data = bus.consumer(input_topic, from_beginning=True)
         self._queries = bus.consumer(query_topic, from_beginning=False)
@@ -82,6 +93,9 @@ class SkylineWorker:
         """Engine counters + worker I/O counters (served by /stats)."""
         out = self.engine.stats()
         out["results_emitted"] = self.results_emitted
+        out["phase_breakdown_ms"] = {
+            k: round(v["total_ms"], 1) for k, v in self.tracer.report().items()
+        }
         return out
 
     def close(self) -> None:
@@ -119,15 +133,20 @@ class SkylineWorker:
         heuristic (FlinkSkyline.java:351) for a partition that got nothing
         in ``max_drain_polls * max_records`` drained rows.
         """
-        triggers = self._queries.poll(max_records)
-        lines = self._data.poll(max_records)
+        with self.tracer.phase("worker/poll"):
+            triggers = self._queries.poll(max_records)
+            lines = self._data.poll(max_records)
         total_lines = 0
         drains = 0
         while lines:
             total_lines += len(lines)
-            ids, values, dropped = parse_tuple_lines(lines, self.engine.config.dims)
+            with self.tracer.phase("worker/parse"):
+                ids, values, dropped = parse_tuple_lines(
+                    lines, self.engine.config.dims
+                )
             self.engine.dropped += dropped
-            self.engine.process_records(ids, values)
+            with self.tracer.phase("worker/ingest"):
+                self.engine.process_records(ids, values)
             if not triggers:
                 break  # no trigger pending: one poll per cycle as before
             if drains >= self.max_drain_polls:
@@ -147,14 +166,42 @@ class SkylineWorker:
                 )
                 break
             drains += 1
-            lines = self._data.poll(max_records)
-        for t in triggers:
-            self.engine.process_trigger(t)
-        self.engine.check_timeouts()
+            with self.tracer.phase("worker/poll"):
+                lines = self._data.poll(max_records)
+        with self.tracer.phase("worker/query"):
+            for t in triggers:
+                self.engine.process_trigger(t)
+            self.engine.check_timeouts()
         for result in self.engine.poll_results():
             self.bus.produce(self.output_topic, format_result(result))
             self.results_emitted += 1
+            self._report_phases()
         return total_lines + len(triggers)
+
+    def _report_phases(self) -> None:
+        """Per-result stderr breakdown: the DELTA of each phase since the
+        previous report, so each line attributes only the wall spent since
+        the last answered query (worker/* rows are the loop's own
+        accounting; engine rows — partition_ids/route/flush/query — nest
+        inside them). Rate-limited to one line per second so per-slide
+        sliding emissions don't flood stderr; /stats always serves the
+        cumulative totals."""
+        now = time.monotonic()
+        if now - self._last_phase_report_s < 1.0:
+            return
+        self._last_phase_report_s = now
+        totals = {
+            k: v["total_ms"] for k, v in self.tracer.report().items()
+        }
+        delta = {
+            k: round(ms - self._phase_snapshot_ms.get(k, 0.0))
+            for k, ms in totals.items()
+            if ms - self._phase_snapshot_ms.get(k, 0.0) >= 0.5
+        }
+        self._phase_snapshot_ms = totals
+        if delta:
+            print(f"skyline worker: phase_breakdown_ms={delta}",
+                  file=sys.stderr, flush=True)
 
     def run_forever(self, idle_sleep_s: float = 0.01, stop_after_idle_s: float | None = None):
         """Poll loop; optionally exits after ``stop_after_idle_s`` of silence."""

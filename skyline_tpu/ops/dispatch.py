@@ -21,13 +21,17 @@ def on_tpu() -> bool:
 
 def rank_cascade() -> bool:
     """``SKYLINE_RANK_CASCADE`` selects the dense-rank dominance cascade
-    for the self-skyline passes (ops/pallas_dominance.py rank kernels) —
-    default ON for TPU (set ``=0`` to force the value cascade; the A/B is
-    committed as artifacts/rank_cascade_ab.json). Read lazily at trace
-    time; already-compiled executables are unaffected by later changes."""
+    for the self-skyline passes (ops/pallas_dominance.py rank kernels).
+    Default OFF until the hardware A/B lands: the op-count argument (2 vs 3
+    VPU ops/dim) favors ranks, but rank_transform's two sorts + searchsorted
+    per pass are unmeasured on TPU — run ``benchmarks/rank_cascade.py``
+    (queued in scripts/tpu_round5_measure.sh, writes
+    artifacts/rank_cascade_ab.json) and flip the default only on a >=1.15x
+    measured win. Read lazily at trace time; already-compiled executables
+    are unaffected by later changes."""
     import os
 
-    return os.environ.get("SKYLINE_RANK_CASCADE", "1") != "0"
+    return os.environ.get("SKYLINE_RANK_CASCADE", "0") != "0"
 
 
 def skyline_mask_auto(x, valid=None):

@@ -24,8 +24,10 @@ counted; equal values share a rank), and the strictness test collapses to
 ONE precomputed rank-sum compare per pair: ``a dominates b  <=>
 max_k(ra_k - rb_k) <= 0  AND  rsum_a < rsum_b`` (all-<= with equal sums
 forces equality in every dim since each term is <=). That is 2 VPU ops per
-dim + 2 instead of 3 per dim + 2 — see ``_dom_tile_rank`` and the A/B
-artifact ``artifacts/rank_cascade_ab.json`` (benchmarks/rank_cascade.py).
+dim + 2 instead of 3 per dim + 2 — see ``_dom_tile_rank``. The hardware
+A/B (benchmarks/rank_cascade.py -> artifacts/rank_cascade_ab.json) is
+queued in scripts/tpu_round5_measure.sh; until it lands the value cascade
+stays the default (ops/dispatch.py).
 Rank sums stay exact in f32 (ranks < N <= 2^20, sums < d * N << 2^24).
 """
 
