@@ -203,6 +203,8 @@ def child_main(backend: str) -> None:
                 "windows_measured": windows,
                 "algo": algo,
                 "skyline_size_p50": int(np.median(sky_sizes)),
+                "flush_policy": cfg.flush_policy,
+                "rank_cascade": os.environ.get("SKYLINE_RANK_CASCADE", "0") != "0",
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",

@@ -30,13 +30,20 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def bench_one(n: int, d: int, repeats: int) -> dict:
+def bench_one(n: int, d: int, repeats: int, interpret: bool = False) -> dict:
+    import functools
+
     import jax.numpy as jnp
 
     from skyline_tpu.ops.pallas_dominance import (
-        skyline_mask_pallas,
-        skyline_mask_rank_pallas,
+        skyline_mask_pallas as _mask_value,
+        skyline_mask_rank_pallas as _mask_rank,
     )
+
+    # --interpret: emulated Pallas for off-TPU smoke runs of this harness
+    # (orders of magnitude slower — timings are then meaningless)
+    skyline_mask_pallas = functools.partial(_mask_value, interpret=interpret)
+    skyline_mask_rank_pallas = functools.partial(_mask_rank, interpret=interpret)
 
     rng = np.random.default_rng(0)
     base = rng.uniform(0, 10000, (n, 1))
@@ -78,9 +85,18 @@ def main(argv=None):
     ap.add_argument("--sizes", type=int, nargs="+", default=[262144, 524288])
     ap.add_argument("--dims", type=int, nargs="+", default=[8, 16])
     ap.add_argument("--out", default="artifacts/rank_cascade_ab.json")
+    ap.add_argument("--interpret", action="store_true",
+                    help="emulated Pallas (CPU smoke runs; timings "
+                         "meaningless, correctness assert still real)")
     a = ap.parse_args(argv)
 
     import jax
+
+    # belt and braces (same as run_configs.py): JAX_PLATFORMS=cpu alone has
+    # been observed to still initialize the axon TPU plugin, which hangs
+    # when the tunnel is down — the config update actually pins the backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     results = {
         "backend": jax.default_backend(),
@@ -89,7 +105,7 @@ def main(argv=None):
     }
     for n in a.sizes:
         for d in a.dims:
-            row = bench_one(n, d, a.repeats)
+            row = bench_one(n, d, a.repeats, interpret=a.interpret)
             print(json.dumps(row), flush=True)
             results["rows"].append(row)
     if a.out:

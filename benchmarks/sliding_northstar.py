@@ -49,6 +49,12 @@ def main(argv=None):
     enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
     import jax
 
+    # belt and braces (same as run_configs.py): JAX_PLATFORMS=cpu alone has
+    # been observed to still initialize the axon TPU plugin, which hangs
+    # when the tunnel is down — the config update actually pins the backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     from skyline_tpu.stream.engine import EngineConfig
     from skyline_tpu.stream.sliding_engine import SlidingEngine
     from skyline_tpu.workload.generators import anti_correlated

@@ -292,6 +292,8 @@ class KafkaLiteConsumer:
         # lines); without this buffer every poll would re-fetch and
         # re-decode the same blob just to deliver its next 64k slice
         self._pending: list[str] = []
+        # None = unprobed; set once on first poll_arrays (static per process)
+        self._arrays_ok: bool | None = None
         self.fetch_max_bytes = fetch_max_bytes
         # Metadata request auto-creates the topic on the embedded broker,
         # matching the reference's auto-create reliance
@@ -342,14 +344,14 @@ class KafkaLiteConsumer:
         offset commit or position report must use."""
         return self._position() - len(self._pending)
 
-    def poll(
-        self, max_records: int = 65536, timeout_ms: int = 100
-    ) -> list[str]:
-        if self._pending:
-            out = self._pending[:max_records]
-            del self._pending[:max_records]
-            return out
-        offset = self._position()
+    def _fetch(self, offset: int, timeout_ms: int) -> list[bytes]:
+        """One fetch request at ``offset``; returns the raw RecordBatch
+        blobs (usually one). OFFSET_OUT_OF_RANGE (log truncated/reset under
+        us) re-resolves the position for the next poll and yields no blob —
+        ``_pending`` is structurally empty whenever a fetch runs (both poll
+        flavors early-return/drain it first), so already-decoded records
+        were served before the reset was observable: the normal
+        at-least-once behavior."""
         body = (
             P.Writer()
             .int32(-1)  # replica_id
@@ -379,32 +381,88 @@ class KafkaLiteConsumer:
             return part, err, hw, blob
 
         responses = r.array(lambda rr: (rr.string(), rr.array(read_pr)))
-        out: list[str] = []
+        blobs: list[bytes] = []
         for _name, prs in responses or []:
-            for _part, err, hw, blob in prs or []:
+            for _part, err, _hw, blob in prs or []:
                 if err == P.ERR_OFFSET_OUT_OF_RANGE:
-                    # log truncated/reset under us: re-resolve and retry
-                    # next poll. _pending is structurally empty here (poll
-                    # early-returns while it holds records, so a fetch —
-                    # the only place OOR appears — never runs with content);
-                    # already-decoded records were served before the reset
-                    # was observable, the normal at-least-once behavior.
                     self._offset = None
                     continue
                 if err != P.ERR_NONE:
                     raise KafkaLiteError(f"fetch error {err}")
-                # decode the WHOLE blob once: records past max_records go to
-                # the pending buffer (served by later polls), not back to the
-                # broker for a redundant re-fetch + re-decode
-                for abs_off, _key, value in P.decode_record_batches(
-                    blob, verify_crc=self.check_crcs
-                ):
-                    if abs_off < offset:
-                        continue
-                    target = out if len(out) < max_records else self._pending
-                    target.append((value or b"").decode("utf-8"))
-                    self._offset = abs_off + 1
+                if blob:
+                    blobs.append(blob)
+        return blobs
+
+    def poll(
+        self, max_records: int = 65536, timeout_ms: int = 100
+    ) -> list[str]:
+        if self._pending:
+            out = self._pending[:max_records]
+            del self._pending[:max_records]
+            return out
+        offset = self._position()
+        out: list[str] = []
+        for blob in self._fetch(offset, timeout_ms):
+            # decode the WHOLE blob once: records past max_records go to
+            # the pending buffer (served by later polls), not back to the
+            # broker for a redundant re-fetch + re-decode
+            for abs_off, _key, value in P.decode_record_batches(
+                blob, verify_crc=self.check_crcs
+            ):
+                if abs_off < offset:
+                    continue
+                target = out if len(out) < max_records else self._pending
+                target.append((value or b"").decode("utf-8"))
+                self._offset = abs_off + 1
         return out
+
+    def poll_arrays(self, dims: int, timeout_ms: int = 100):
+        """Data-plane poll straight to numpy: one fetch, decoded AND
+        CSV-parsed in native code (``native.parse_recordbatches_native``)
+        into ``(ids (n,) int64, values (n, dims) float32, dropped)`` — the
+        consume-plane twin of the producer's ``send_blob``, with zero
+        per-record Python objects between broker and engine. Returns None
+        when the native library is unavailable (callers fall back to
+        ``poll()`` + line parsing). If line-based ``poll()`` left
+        decoded-but-undelivered records pending, those are drained first
+        through the line parser so mixing the APIs stays ordered. Unlike
+        ``poll()`` there is no pending buffer: the whole fetch blob is
+        parsed and delivered in one call (the worker drains the topic
+        anyway), so ``max_records`` slicing does not apply."""
+        import numpy as np
+
+        from skyline_tpu.bridge.wire import parse_tuple_lines
+        from skyline_tpu.native import parse_recordbatches_native
+
+        if self._arrays_ok is None:  # availability is static per process
+            self._arrays_ok = parse_recordbatches_native(b"", 0, 1) is not None
+        if not self._arrays_ok:
+            return None
+        if self._pending:
+            lines, self._pending = self._pending, []
+            return parse_tuple_lines(lines, dims)
+        offset = self._position()
+        chunks: list[tuple] = []
+        for blob in self._fetch(offset, timeout_ms):
+            ids, values, dropped, next_off = parse_recordbatches_native(
+                blob, offset, dims, verify_crc=self.check_crcs
+            )
+            if next_off > offset:
+                self._offset = next_off
+            chunks.append((ids, values, dropped))
+        if not chunks:
+            return (
+                np.empty(0, dtype=np.int64),
+                np.empty((0, dims), dtype=np.float32),
+                0,
+            )
+        if len(chunks) == 1:
+            return chunks[0]
+        return (
+            np.concatenate([c[0] for c in chunks]),
+            np.concatenate([c[1] for c in chunks]),
+            sum(c[2] for c in chunks),
+        )
 
     def close(self) -> None:
         self._conn.close()

@@ -57,6 +57,11 @@ class SkylineWorker:
         self.tracer = tracer if tracer is not None else Tracer(sync_device=False)
         self._phase_snapshot_ms: dict[str, float] = {}
         self._last_phase_report_s = 0.0
+        # None = undecided, True = zero-copy array plane, False = line plane
+        self._arrays_plane: bool | None = None
+        # (ids, values) tail of an oversized array batch, served in
+        # max_records micro-batches by subsequent _poll_data calls
+        self._data_carry: tuple | None = None
         if window_size:
             from skyline_tpu.stream.sliding_engine import SlidingEngine
 
@@ -102,6 +107,61 @@ class SkylineWorker:
         if self.stats_server is not None:
             self.stats_server.close()
 
+    def _poll_data(self, max_records: int):
+        """One data-topic poll as ``(ids, values, dropped, got)`` where
+        ``got`` counts raw records received (parsed + dropped — the idle /
+        drain-bound signal). Prefers the transport's zero-copy array plane
+        (kafkalite ``poll_arrays``: fetch blob -> native RecordBatch walk +
+        CSV parse -> numpy, no per-record Python objects); falls back to
+        line ``poll()`` + ``parse_tuple_lines`` for transports without it
+        (MemoryBus, kafka-python) or when the native library is absent.
+        The choice is latched on first resolution."""
+        import numpy as np
+
+        dims = self.engine.config.dims
+        if self._data_carry is not None:
+            # tail of a previous oversized array batch: serve the next
+            # max_records micro-batch, preserving step()'s chunk contract
+            ids, values = self._data_carry
+            head_i, head_v = ids[:max_records], values[:max_records]
+            self._data_carry = (
+                (ids[max_records:], values[max_records:])
+                if ids.shape[0] > max_records
+                else None
+            )
+            return head_i, head_v, 0, head_i.shape[0]
+        if self._arrays_plane is not False:
+            poll_arrays = getattr(self._data, "poll_arrays", None)
+            if poll_arrays is None:
+                self._arrays_plane = False
+            else:
+                res = poll_arrays(dims)
+                if res is None:  # native lib unavailable: latch line path
+                    self._arrays_plane = False
+                else:
+                    self._arrays_plane = True
+                    ids, values, dropped = res
+                    if ids.shape[0] > max_records:
+                        # one fetch can carry ~10-100x max_records; keep
+                        # engine micro-batches at the documented size
+                        self._data_carry = (
+                            ids[max_records:],
+                            values[max_records:],
+                        )
+                        ids, values = ids[:max_records], values[:max_records]
+                    return ids, values, dropped, ids.shape[0] + dropped
+        lines = self._data.poll(max_records)
+        if not lines:
+            return (
+                np.empty(0, dtype=np.int64),
+                np.empty((0, dims), dtype=np.float32),
+                0,
+                0,
+            )
+        with self.tracer.phase("worker/parse"):
+            ids, values, dropped = parse_tuple_lines(lines, dims)
+        return ids, values, dropped, len(lines)
+
     def step(self, max_records: int = 65536) -> int:
         """One poll cycle: snapshot triggers, ingest data, then apply the
         triggers. Returns the number of messages processed (0 == idle).
@@ -135,18 +195,15 @@ class SkylineWorker:
         """
         with self.tracer.phase("worker/poll"):
             triggers = self._queries.poll(max_records)
-            lines = self._data.poll(max_records)
+            ids, values, dropped, got = self._poll_data(max_records)
         total_lines = 0
         drains = 0
-        while lines:
-            total_lines += len(lines)
-            with self.tracer.phase("worker/parse"):
-                ids, values, dropped = parse_tuple_lines(
-                    lines, self.engine.config.dims
-                )
+        while got:
+            total_lines += got
             self.engine.dropped += dropped
-            with self.tracer.phase("worker/ingest"):
-                self.engine.process_records(ids, values)
+            if ids.shape[0]:
+                with self.tracer.phase("worker/ingest"):
+                    self.engine.process_records(ids, values)
             if not triggers:
                 break  # no trigger pending: one poll per cycle as before
             if drains >= self.max_drain_polls:
@@ -167,7 +224,7 @@ class SkylineWorker:
                 break
             drains += 1
             with self.tracer.phase("worker/poll"):
-                lines = self._data.poll(max_records)
+                ids, values, dropped, got = self._poll_data(max_records)
         with self.tracer.phase("worker/query"):
             for t in triggers:
                 self.engine.process_trigger(t)

@@ -267,6 +267,127 @@ inline char* write_i64(char* w, int64_t v) {
 
 }  // namespace
 
+namespace {
+
+// Bounds-checked zigzag varint read; false on truncation/overflow.
+inline bool read_zigzag(const uint8_t*& p, const uint8_t* end, int64_t& out) {
+    uint64_t z = 0;
+    int shift = 0;
+    while (p < end) {
+        const uint8_t b = *p++;
+        z |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            out = static_cast<int64_t>((z >> 1) ^ (~(z & 1) + 1));
+            return true;
+        }
+        shift += 7;
+        if (shift > 63) return false;
+    }
+    return false;
+}
+
+inline uint32_t be32(const uint8_t* p) {
+    return (static_cast<uint32_t>(p[0]) << 24) |
+           (static_cast<uint32_t>(p[1]) << 16) |
+           (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+inline int64_t be64(const uint8_t* p) {
+    return static_cast<int64_t>((static_cast<uint64_t>(be32(p)) << 32) |
+                                be32(p + 4));
+}
+
+}  // namespace
+
+extern "C" uint32_t sky_crc32c(const uint8_t* data, int64_t n);
+
+// Consume-plane twin of sky_encode_records + sky_parse_tuples: walk a
+// concatenation of RecordBatch v2 blobs (one fetch response's record set,
+// bridge/kafkalite/protocol.py decode_record_batches) and CSV-parse each
+// record's value straight into the caller's (ids, values) numpy buffers —
+// zero per-record Python objects on the whole broker->engine path. Mirrors
+// the Python decode exactly: tolerates a truncated trailing batch, skips
+// records below `min_offset` (a fetch can return a batch starting before
+// the requested offset), keys and headers are skipped via the record
+// length, `*next_offset` tracks last-seen-abs+1 (the fetch position
+// advance), malformed CSV values count into `*dropped`.
+//
+// Returns rows parsed (stops at max_rows; remaining records stay
+// re-fetchable at *next_offset... callers size max_rows to len/9, the
+// framing minimum, so a single pass always completes), or a negative
+// error: -2 unsupported magic, -3 CRC32C mismatch (verify_crc=1),
+// -4 malformed record framing inside a complete batch. All three raise in
+// the Python wrapper, matching decode_record_batches' behavior.
+extern "C" int64_t sky_parse_recordbatches(
+    const uint8_t* buf, int64_t len, int64_t min_offset, int32_t dims,
+    int32_t verify_crc, int64_t max_rows, int64_t* ids, float* values,
+    int64_t* dropped, int64_t* next_offset) {
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + len;
+    int64_t rows = 0;
+    int64_t bad = 0;
+    while (end - p >= 12) {
+        const int64_t base = be64(p);
+        const int64_t blen = be32(p + 8);
+        if (end - p - 12 < blen) break;  // truncated tail
+        const uint8_t* batch = p + 12;
+        p += 12 + blen;
+        if (blen < 49) return -4;  // shorter than a v2 batch header
+        if (batch[4] != 2) return -2;
+        if (verify_crc &&
+            sky_crc32c(batch + 9, blen - 9) != be32(batch + 5))
+            return -3;
+        const int64_t n = static_cast<int64_t>(be32(batch + 45));
+        const uint8_t* q = batch + 49;
+        const uint8_t* qe = batch + blen;
+        for (int64_t i = 0; i < n; ++i) {
+            int64_t rec_len, off_delta, klen, vlen, tmp;
+            if (!read_zigzag(q, qe, rec_len)) return -4;
+            const uint8_t* rec_end = q + rec_len;
+            if (rec_len < 0 || rec_end > qe) return -4;
+            if (q >= rec_end) return -4;
+            ++q;  // attributes
+            if (!read_zigzag(q, rec_end, tmp)) return -4;  // timestampDelta
+            if (!read_zigzag(q, rec_end, off_delta)) return -4;
+            if (!read_zigzag(q, rec_end, klen)) return -4;
+            if (klen > 0) {
+                if (rec_end - q < klen) return -4;
+                q += klen;  // key skipped (data-plane records are value-only)
+            }
+            if (!read_zigzag(q, rec_end, vlen)) return -4;
+            if (vlen > 0 && rec_end - q < vlen) return -4;
+            const int64_t abs = base + off_delta;
+            *next_offset = abs + 1;
+            if (abs >= min_offset) {
+                if (rows >= max_rows) {
+                    *next_offset = abs;  // this record not consumed
+                    *dropped = bad;
+                    return rows;
+                }
+                bool ok = vlen > 0;
+                if (ok) {
+                    const char* v = reinterpret_cast<const char*>(q);
+                    const char* ve = v + vlen;
+                    int64_t id = 0;
+                    ok = parse_id(v, ve, id);
+                    float* row = values + rows * dims;
+                    for (int32_t k = 0; ok && k < dims; ++k) {
+                        ok = (v < ve && *v == ',');
+                        if (ok) ++v;
+                        if (ok) ok = parse_value(v, ve, row[k]);
+                    }
+                    if (ok && v != ve) ok = false;
+                    if (ok) ids[rows++] = id;
+                }
+                if (!ok) ++bad;
+            }
+            q = rec_end;  // headers (if any) skipped via the record length
+        }
+    }
+    *dropped = bad;
+    return rows;
+}
+
 // Format n data-plane lines "id,v1,...,vd" (no separators between records —
 // `offsets` carries the n+1 prefix offsets, so record i is
 // out[offsets[i]:offsets[i+1]]). The produce-plane twin of sky_parse_tuples:

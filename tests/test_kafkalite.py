@@ -310,3 +310,75 @@ def test_send_blob_roundtrip_and_cap_split():
             prod.send_blob(
                 "t", big, np.array([0, len(big)], dtype=np.int64)
             )
+
+
+def test_poll_arrays_matches_line_path(broker):
+    """The zero-copy consume plane (native RecordBatch walk + CSV parse)
+    delivers exactly what poll() + parse_tuple_lines would, including
+    malformed-row drops and offset advance."""
+    from skyline_tpu.bridge.wire import parse_tuple_lines
+    from skyline_tpu.native import parse_recordbatches_native
+
+    if parse_recordbatches_native(b"", 0, 2) is None:
+        pytest.skip("native library unavailable")
+    prod = KafkaLiteProducer(broker.address)
+    rng = np.random.default_rng(3)
+    lines = [
+        f"{i},{rng.integers(0, 100)},{rng.integers(0, 100)}"
+        for i in range(5000)
+    ]
+    lines[17] = "badid,1,2"
+    lines[4000] = "7,nan,3"
+    prod.send_many("pa", lines)
+    prod.flush()
+
+    c_lines = KafkaLiteConsumer("pa", broker.address)
+    got = []
+    for _ in range(30):
+        got.extend(c_lines.poll())
+        if len(got) >= 5000:
+            break
+    want_ids, want_vals, want_drop = parse_tuple_lines(got, 2)
+
+    c_arr = KafkaLiteConsumer("pa", broker.address)
+    ids = np.empty(0, np.int64)
+    vals = np.empty((0, 2), np.float32)
+    drop = 0
+    for _ in range(30):
+        i2, v2, d2 = c_arr.poll_arrays(2)
+        ids = np.concatenate([ids, i2])
+        vals = np.concatenate([vals, v2])
+        drop += d2
+        if ids.shape[0] + drop >= 5000:
+            break
+    np.testing.assert_array_equal(ids, want_ids)
+    np.testing.assert_allclose(vals, want_vals)
+    assert drop == want_drop == 2
+    assert c_arr.position() == c_lines.position() == 5000
+    prod.close()
+    c_lines.close()
+    c_arr.close()
+
+
+def test_poll_arrays_drains_pending_from_mixed_use(broker):
+    """Interleaving poll() (which buffers undelivered decoded records) with
+    poll_arrays() must preserve stream order: pending lines drain through
+    the parser before any new fetch."""
+    from skyline_tpu.native import parse_recordbatches_native
+
+    if parse_recordbatches_native(b"", 0, 1) is None:
+        pytest.skip("native library unavailable")
+    prod = KafkaLiteProducer(broker.address)
+    prod.send_many("mx", [f"{i},{i}" for i in range(500)])
+    prod.flush()
+    cons = KafkaLiteConsumer("mx", broker.address)
+    first = cons.poll(max_records=100)  # leaves 400 pending
+    assert len(first) == 100 and cons.position() == 100
+    ids, vals, drop = cons.poll_arrays(1)
+    # pending (400) delivered first, in order
+    assert ids[0] == 100 and ids.shape[0] == 400 and drop == 0
+    ids2, _, _ = cons.poll_arrays(1)  # nothing left
+    assert ids2.shape[0] == 0
+    assert cons.position() == 500
+    prod.close()
+    cons.close()

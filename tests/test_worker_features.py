@@ -217,3 +217,71 @@ def test_stats_dashboard_served():
             assert json.load(r)["records_in"] == 7
     finally:
         srv.close()
+
+
+def test_arrays_plane_oversized_batch_chunks_to_max_records(rng):
+    """A transport whose poll_arrays returns far more rows than
+    ``max_records`` (one 16 MB fetch can carry ~100x the micro-batch size)
+    must still feed the engine in max_records chunks — the carry buffer
+    preserves step()'s documented ingest granularity and order."""
+    import numpy as np
+
+    from skyline_tpu.stream import EngineConfig
+
+    class ArraysBus(MemoryBus):
+        """MemoryBus whose data consumer serves one big array batch."""
+
+        def __init__(self, ids, values):
+            super().__init__()
+            self._ids, self._values = ids, values
+            self._served = False
+            outer = self
+
+            class _ArraysConsumer:
+                def poll(self, max_records=65536):
+                    return []
+
+                def poll_arrays(self, dims):
+                    if outer._served:
+                        return (
+                            np.empty(0, np.int64),
+                            np.empty((0, dims), np.float32),
+                            0,
+                        )
+                    outer._served = True
+                    return outer._ids, outer._values, 3  # 3 fake drops
+
+            self._arrays_consumer = _ArraysConsumer()
+
+        def consumer(self, topic, from_beginning=True):
+            if topic == "input-tuples":
+                return self._arrays_consumer
+            return super().consumer(topic, from_beginning)
+
+    n = 1000
+    values = rng.uniform(0, 100, (n, 2)).astype(np.float32)
+    ids = np.arange(n, dtype=np.int64)
+    bus = ArraysBus(ids, values)
+    w = SkylineWorker(
+        bus, EngineConfig(parallelism=2, algo="mr-dim", dims=2, domain_max=100.0)
+    )
+    seen = []
+    orig = w.engine.process_records
+
+    def spy(ids_, vals_, now_ms=None):
+        seen.append(ids_.shape[0])
+        return orig(ids_, vals_, now_ms=now_ms)
+
+    w.engine.process_records = spy
+    got = w.step(max_records=256)
+    assert got == 256 + 3  # first micro-batch + the reported drops
+    while w.step(max_records=256):
+        pass
+    assert seen == [256, 256, 256, 232]
+    assert w.engine.dropped == 3
+    assert w.engine.records_in == n
+    # stream order preserved across the carry
+    bus.produce("queries", "1,900")
+    w.step()
+    out = bus.consumer("output-skyline", from_beginning=True).poll(5)
+    assert len(out) == 1
