@@ -36,6 +36,13 @@ def rank_cascade() -> bool:
 
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
+    if x.shape[1] <= 2:
+        # d <= 2 needs no pairwise work at all: sort + prefix-min sweep
+        # (ops/sweep2d.py), O(n log n) on every backend — at the 262k-row
+        # union bucket that replaces ~69G pair-ops with one sort
+        from skyline_tpu.ops.sweep2d import skyline_mask_sweep
+
+        return skyline_mask_sweep(x, valid)
     if on_tpu():
         from skyline_tpu.ops.pallas_dominance import (
             skyline_mask_pallas,

@@ -1,0 +1,201 @@
+"""Exact low-dimensional skylines by sort + prefix-min sweep.
+
+At d <= 2 the skyline does not need pairwise dominance at all: sort points
+lexicographically by (x, y) and a point survives iff no earlier point
+dominates it, which collapses to two comparisons against running minima —
+O(n log n) total, expressed as one XLA sort plus scans (no Pallas, no
+N^2 tiles). The reference's published headline grid is 2D/3D
+(graph_paper_figures.py:28-42), so this is the fast path for exactly the
+cells its paper reports; dominance semantics match ops/dominance.py
+(min-better, strict in at least one dim — duplicates all survive,
+ServiceTuple.java:67-77 parity).
+
+Derivation (d = 2, ascending lexsort by (x, y)): for a point p, every
+candidate dominator q precedes it in sort order. Split by x:
+- some q with q.x < p.x dominates p  iff  min{q.y : q.x < p.x} <= p.y
+  (strictness holds via x);
+- some q with q.x == p.x dominates p  iff  that group holds a y < p.y,
+  i.e. p.y > the group's minimum y (the group's first element, since ties
+  sort by y).
+Points equal in BOTH dims share a group minimum and all survive.
+
+The partitioned variant sorts ONE concatenated buffer by (pid, x, y) and
+resets the running minima at partition boundaries via a segmented scan —
+the whole multi-partition flush becomes a single sort + scan + scatter
+launch (stream/batched.py uses it to replace SFS rounds at d <= 2).
+
+All functions are jit-compiled with static shapes; invalid rows ride along
+as +inf (they sort last within their segment and can never dominate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def skyline_mask_sweep2(x: jax.Array, valid: jax.Array) -> jax.Array:
+    """Survivor mask of (n, 2) points (min-better), False on invalid rows.
+
+    Semantically identical to ``ops.block_skyline.skyline_mask_scan`` /
+    the Pallas kernels at d=2, in O(n log n).
+    """
+    n = x.shape[0]
+    inf = jnp.inf
+    xs_raw = jnp.where(valid, x[:, 0], inf)
+    ys_raw = jnp.where(valid, x[:, 1], inf)
+    order = jnp.lexsort((ys_raw, xs_raw))
+    xs = xs_raw[order]
+    ys = ys_raw[order]
+    # index of the current x-group's first element
+    first_in_group = jnp.concatenate(
+        [jnp.ones((1,), bool), xs[1:] != xs[:-1]]
+    )
+    gs_idx = jax.lax.cummax(
+        jnp.where(first_in_group, jnp.arange(n), 0)
+    )
+    # min y over all points with strictly smaller x = inclusive cummin of y
+    # at the previous group's last element
+    m = jax.lax.cummin(ys)
+    prev_min = jnp.where(gs_idx > 0, m[jnp.maximum(gs_idx - 1, 0)], inf)
+    dominated = (prev_min <= ys) | (ys > ys[gs_idx])
+    keep_sorted = ~dominated
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep & valid
+
+
+@jax.jit
+def skyline_mask_sweep1(x: jax.Array, valid: jax.Array) -> jax.Array:
+    """d=1: every copy of the valid minimum survives."""
+    v = jnp.where(valid, x[:, 0], jnp.inf)
+    return (v == jnp.min(v)) & valid
+
+
+def skyline_mask_sweep(x: jax.Array, valid: jax.Array | None = None):
+    """Dispatch by dimensionality (d <= 2 only)."""
+    if valid is None:
+        valid = jnp.ones((x.shape[0],), bool)
+    d = x.shape[1]
+    if d == 1:
+        return skyline_mask_sweep1(x, valid)
+    if d == 2:
+        return skyline_mask_sweep2(x, valid)
+    raise ValueError(f"sweep skyline supports d <= 2, got {d}")
+
+
+def _segmented_cummin(y: jax.Array, seg_start: jax.Array) -> jax.Array:
+    """Inclusive running min of ``y`` that restarts wherever ``seg_start``
+    is True (associative, so one logarithmic scan)."""
+
+    def combine(a, b):
+        m_a, s_a = a
+        m_b, s_b = b
+        return jnp.where(s_b, m_b, jnp.minimum(m_a, m_b)), s_a | s_b
+
+    m, _ = jax.lax.associative_scan(combine, (y, seg_start))
+    return m
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def partitioned_sweep2_core(
+    values: jax.Array,
+    pids: jax.Array,
+    valid: jax.Array,
+    num_partitions: int,
+):
+    """Sort + sweep phase of the partitioned 2D skyline.
+
+    values: (N, 2); pids: (N,) partition of each row (any value on invalid
+    rows); valid: (N,) bool. One lexsort by (pid, x, y), then the sweep
+    recurrences with running minima reset at partition boundaries.
+    Returns ``(rows_sorted (N, 2) f32, p_sorted (N,) i32 [sentinel P on
+    invalid], keep (N,) bool, rank (N,) i32 survivor rank within its
+    partition, counts (P,) i32)`` — callers sync ``counts`` to size the
+    output buffer exactly, then scatter with ``scatter_sweep2``.
+    """
+    n = values.shape[0]
+    inf = jnp.inf
+    pid_s = jnp.where(valid, pids.astype(jnp.int32), num_partitions)
+    xs_raw = jnp.where(valid, values[:, 0], inf)
+    ys_raw = jnp.where(valid, values[:, 1], inf)
+    order = jnp.lexsort((ys_raw, xs_raw, pid_s))
+    p = pid_s[order]
+    xs = xs_raw[order]
+    ys = ys_raw[order]
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), p[1:] != p[:-1]])
+    grp_start = seg_start | jnp.concatenate(
+        [jnp.ones((1,), bool), xs[1:] != xs[:-1]]
+    )
+    idx = jnp.arange(n)
+    gs_idx = jax.lax.cummax(jnp.where(grp_start, idx, 0))
+    m = _segmented_cummin(ys, seg_start)
+    # min y among SAME-partition points with strictly smaller x: the
+    # segmented cummin at the previous x-group's last element, masked off
+    # when that element belongs to a different partition (group == segment
+    # start means "no smaller-x points in this partition")
+    at_prev = m[jnp.maximum(gs_idx - 1, 0)]
+    has_prev = ~seg_start[gs_idx] & (gs_idx > 0)
+    prev_min = jnp.where(has_prev, at_prev, inf)
+    dominated = (prev_min <= ys) | (ys > ys[gs_idx])
+    keep = ~dominated & (p < num_partitions)
+    # rank within partition among survivors = segmented cumsum, exclusive
+    ones = keep.astype(jnp.int32)
+
+    def add_seg(a, b):
+        c_a, s_a = a
+        c_b, s_b = b
+        return jnp.where(s_b, c_b, c_a + c_b), s_a | s_b
+
+    csum, _ = jax.lax.associative_scan(add_seg, (ones, seg_start))
+    rank = csum - ones  # exclusive
+    counts = jnp.zeros((num_partitions,), jnp.int32).at[
+        jnp.where(keep, p, num_partitions)
+    ].add(ones, mode="drop")
+    rows = jnp.stack([xs, ys], axis=1).astype(jnp.float32)
+    return rows, p, keep, rank, counts
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "cap"))
+def scatter_sweep2(
+    rows_sorted: jax.Array,
+    p_sorted: jax.Array,
+    keep: jax.Array,
+    rank: jax.Array,
+    counts: jax.Array,
+    num_partitions: int,
+    cap: int,
+):
+    """Scatter phase: pack ``partitioned_sweep2_core`` survivors into the
+    stacked ``(P, cap, 2)`` +inf-padded layout stream/batched.py stores
+    partition skylines in. Survivors past ``cap`` are dropped — callers
+    size ``cap`` from the synced counts (or a proven bound) so that never
+    happens. Returns ``(sky, counts)`` (counts passed through, clipped to
+    cap)."""
+    sky = jnp.full((num_partitions, cap, 2), jnp.inf, dtype=jnp.float32)
+    ok = keep & (rank < cap)
+    scatter_p = jnp.where(ok, p_sorted, num_partitions)
+    scatter_r = jnp.where(ok, rank, 0)
+    sky = sky.at[scatter_p, scatter_r].set(rows_sorted, mode="drop")
+    return sky, jnp.minimum(counts, cap)
+
+
+def partitioned_sweep2(
+    values: jax.Array,
+    pids: jax.Array,
+    valid: jax.Array,
+    num_partitions: int,
+    cap: int,
+):
+    """Per-partition 2D skylines of one mixed buffer: core + scatter.
+
+    Returns ``(sky (P, cap, 2) front-packed +inf-padded, counts (P,) i32)``.
+    Rows beyond ``cap`` survivors in a partition are dropped; callers size
+    ``cap`` large enough (e.g. N) to make that impossible.
+    """
+    rows, p, keep, rank, counts = partitioned_sweep2_core(
+        values, pids, valid, num_partitions
+    )
+    return scatter_sweep2(rows, p, keep, rank, counts, num_partitions, cap)

@@ -425,6 +425,13 @@ class PartitionSet:
         Query-time flushes keep the default (exact buckets for the global
         merge)."""
         total = int(self._pending_rows.sum())
+        if self.dims <= 2 and self.mesh is None:
+            # d <= 2: the whole flush (host pendings + device window + old
+            # skylines, every policy) collapses to one sort-and-sweep pass —
+            # no SFS rounds, no pairwise work (ops/sweep2d.py)
+            if total or self._dev_rows:
+                self._flush_sweep()
+            return
         if self.flush_policy in ("lazy", "overlap"):
             if total:
                 self._flush_lazy()
@@ -876,6 +883,106 @@ class PartitionSet:
             # comes from _count_ub — loose row-count bounds (vs true
             # survivor counts) can double its pairwise work for nothing
             self.sky_counts()
+        self.processing_ns += time.perf_counter_ns() - t0
+
+    def _flush_sweep(self) -> None:
+        """d <= 2 flush, every policy: union the old skylines, the host
+        pending rows, and the device accumulation window into ONE buffer
+        and take per-partition skylines by sort + segmented prefix-min
+        sweep (ops/sweep2d.py) — O(N log N), no pairwise dominance, no SFS
+        rounds, exact by the merge law (skyline(union) per partition).
+
+        Two launches + one count sync: the core launch yields exact
+        survivor counts, the host sizes storage to their max, the scatter
+        launch packs the stacked (P, cap, d) layout. The sync costs ~ms
+        where the SFS rounds it replaces cost seconds, so the overlap
+        policy's sync-free property is deliberately traded away here.
+        d == 1 rides as (x, 0) pairs: constant second dim makes 2D
+        dominance degenerate to 1D (strictness must come from x)."""
+        t0 = time.perf_counter_ns()
+        from skyline_tpu.ops.sweep2d import (
+            partitioned_sweep2_core,
+            scatter_sweep2,
+        )
+
+        P = self.num_partitions
+        with self.tracer.phase("flush/assemble"):
+            rows = self._drain_pending()
+            host_vals = np.concatenate(
+                [r for r in rows if r.shape[0]] or
+                [np.empty((0, self.dims), np.float32)]
+            )
+            host_pids = np.repeat(
+                np.arange(P, dtype=np.int32),
+                [r.shape[0] for r in rows],
+            )
+        n_host = host_vals.shape[0]
+        # valid prefixes only (the conventions the SFS paths use): the dev
+        # window is allocated in doubling buckets that never shrink, and
+        # sky rows past the active bucket are invalid by the count bounds —
+        # sorting either's full allocation would inflate every flush and
+        # churn n_bucket recompiles
+        dev_bucket = (
+            min(self._dev_cap, _next_pow2(self._dev_rows))
+            if self._dev_rows
+            else 0
+        )
+        sky_active = min(
+            self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
+        )
+        n_in = P * sky_active + n_host + dev_bucket
+        n_bucket = _next_pow2(n_in)
+        pad = n_bucket - n_in
+        with self.tracer.phase("flush/device_put"):
+            host_vals_d = jnp.asarray(host_vals)
+            host_pids_d = jnp.asarray(host_pids)
+        sky_flat = self.sky[:, :sky_active].reshape(
+            P * sky_active, self.dims
+        )
+        sky_pids = jnp.repeat(jnp.arange(P, dtype=jnp.int32), sky_active)
+        sky_ok = self.sky_valid[:, :sky_active].reshape(-1)
+        parts_v = [sky_flat, host_vals_d]
+        parts_p = [sky_pids, host_pids_d]
+        parts_ok = [sky_ok, jnp.ones((n_host,), bool)]
+        if dev_bucket:
+            parts_v.append(self._dev_window[:dev_bucket])
+            parts_p.append(self._dev_pids[:dev_bucket])
+            parts_ok.append(jnp.arange(dev_bucket) < self._dev_rows)
+        if pad:
+            parts_v.append(jnp.full((pad, self.dims), jnp.inf, jnp.float32))
+            parts_p.append(jnp.zeros((pad,), jnp.int32))
+            parts_ok.append(jnp.zeros((pad,), bool))
+        values = jnp.concatenate(parts_v)
+        pids = jnp.concatenate(parts_p)
+        valid = jnp.concatenate(parts_ok)
+        if self.dims == 1:
+            values = jnp.concatenate(
+                [values, jnp.zeros((n_bucket, 1), jnp.float32)], axis=1
+            )
+        with self.tracer.phase("flush/sweep"):
+            srows, sp, keep, rank, counts = partitioned_sweep2_core(
+                values, pids, valid, P
+            )
+            counts_host = np.asarray(counts, dtype=np.int64)  # the one sync
+        new_cap = max(
+            self._cap, _next_pow2(max(int(counts_host.max()), _MIN_CAP))
+        )
+        with self.tracer.phase("flush/sweep"):
+            sky2, counts_dev = scatter_sweep2(
+                srows, sp, keep, rank, counts, P, new_cap
+            )
+            if self.dims == 1:
+                sky2 = sky2[:, :, :1]
+        self.sky = sky2
+        self._cap = new_cap
+        self._count_dev = counts_dev
+        self.sky_valid = (
+            jnp.arange(new_cap)[None, :] < counts_dev[:, None]
+        )
+        self._count_ub = counts_host.copy()
+        self._counts_cache = None
+        self._host_cache = None
+        self._dev_rows = 0
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _flush_lazy_device(self, tighten: bool = True) -> None:

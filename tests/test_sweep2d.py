@@ -1,0 +1,108 @@
+"""Sort-sweep low-dimensional skylines (ops/sweep2d.py): property tests
+against the O(n^2) oracle and the scan kernel, heavy-tie and duplicate
+semantics (ServiceTuple.java:67-77 parity — duplicates all survive), the
+partitioned variant's segment isolation, and the d=1 degenerate encoding
+used by the flush path."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skyline_tpu.ops.block_skyline import skyline_mask_scan
+from skyline_tpu.ops.dominance import skyline_np
+from skyline_tpu.ops.sweep2d import (
+    partitioned_sweep2,
+    skyline_mask_sweep,
+)
+from tests.conftest import assert_same_set
+
+
+def _case(rng, kind, n):
+    if kind == "uniform":
+        return rng.uniform(0, 1000, (n, 2)).astype(np.float32)
+    if kind == "ties":
+        return rng.integers(0, 8, (n, 2)).astype(np.float32)
+    if kind == "anti":
+        b = rng.uniform(0, 1000, (n, 1))
+        return np.abs((1000 - b) + rng.normal(0, 60, (n, 2))).astype(
+            np.float32
+        )
+    return np.tile(rng.uniform(0, 9, (1, 2)).astype(np.float32), (n, 1))
+
+
+@pytest.mark.parametrize("kind", ["uniform", "ties", "anti", "dups"])
+def test_sweep_matches_scan_and_oracle(kind, rng):
+    for n in (1, 7, 512, 2500):
+        x = _case(rng, kind, n)
+        valid = rng.random(n) < 0.85
+        if not valid.any():
+            valid[0] = True
+        got = np.asarray(
+            skyline_mask_sweep(jnp.asarray(x), jnp.asarray(valid))
+        )
+        ref = np.asarray(
+            skyline_mask_scan(
+                jnp.asarray(np.where(valid[:, None], x, np.inf)),
+                jnp.asarray(valid),
+            )
+        )
+        assert (got == ref).all()
+        want = skyline_np(x[valid].astype(np.float64))
+        assert int(got.sum()) == want.shape[0]
+        assert_same_set(x[got], want)
+
+
+def test_sweep_d1_all_minima_survive(rng):
+    x = rng.integers(0, 20, (800, 1)).astype(np.float32)
+    valid = rng.random(800) < 0.9
+    valid[:2] = True
+    got = np.asarray(skyline_mask_sweep(jnp.asarray(x), jnp.asarray(valid)))
+    mn = x[valid].min()
+    assert (got == (valid & (x[:, 0] == mn))).all()
+
+
+def test_sweep_invalid_only_and_pads():
+    x = np.full((16, 2), np.inf, dtype=np.float32)
+    valid = np.zeros(16, dtype=bool)
+    got = np.asarray(skyline_mask_sweep(jnp.asarray(x), jnp.asarray(valid)))
+    assert not got.any()
+
+
+def test_partitioned_sweep_matches_per_partition_oracle(rng):
+    for trial in range(8):
+        P = int(rng.integers(1, 9))
+        n = int(rng.integers(1, 4000))
+        x = rng.integers(0, 40, (n, 2)).astype(np.float32)
+        pids = rng.integers(0, P, n).astype(np.int32)
+        valid = rng.random(n) < 0.9
+        sky, counts = partitioned_sweep2(
+            jnp.asarray(x), jnp.asarray(pids), jnp.asarray(valid), P, n + 1
+        )
+        sky, counts = np.asarray(sky), np.asarray(counts)
+        for p in range(P):
+            want = skyline_np(x[valid & (pids == p)].astype(np.float64))
+            assert counts[p] == want.shape[0]
+            assert_same_set(sky[p][: counts[p]], want)
+            assert np.isinf(sky[p][counts[p] :]).all()
+
+
+def test_partitioned_sweep_cap_clips_not_corrupts(rng):
+    """Survivors past cap are dropped and counts clipped — never scattered
+    out of bounds into another partition."""
+    P, n = 3, 300
+    # all points mutually non-dominating within partition: anti-chain line
+    x = np.stack(
+        [np.arange(n, dtype=np.float32), -np.arange(n, dtype=np.float32)],
+        axis=1,
+    )
+    pids = (np.arange(n) % P).astype(np.int32)
+    valid = np.ones(n, dtype=bool)
+    cap = 8
+    sky, counts = partitioned_sweep2(
+        jnp.asarray(x), jnp.asarray(pids), jnp.asarray(valid), P, cap
+    )
+    sky, counts = np.asarray(sky), np.asarray(counts)
+    assert (counts == cap).all()
+    for p in range(P):
+        assert np.isfinite(sky[p]).all()
+        assert (sky[p][:, 0] % P == p).all()  # rows stayed in their partition
