@@ -17,6 +17,8 @@ What's measured (all warm — compile excluded; median of ``--reps``):
 - ``merge_step_batched``  one full incremental flush step    (P=8, cap=64k, B=8k)
 - ``compact``             the flush's argsort compaction     (P=8, 72k rows)
 - ``skyline_large``       host-driven SFS, whole window      N in {256k, 1M}
+- ``skyline_mask_sweep2`` d=2 sort-sweep (no pairwise work,   N in {64k, 256k, 1M}
+                          so no gpairs_per_s column)
 - ``parse``               native fastcsv vs Python wire parse (100k lines)
 
 Usage: python benchmarks/kernels.py [--reps 5] [--out artifacts/kernels_tpu.json]
@@ -96,6 +98,19 @@ def bench_mask_kernels(reps: int, d: int, results: dict) -> None:
                 "ms": round(t * 1000, 2),
                 "gpairs_per_s": round(pairs / t / 1e9, 1),
             }
+
+    # d=2 sort-sweep (ops/sweep2d.py): no pairwise work, so report ms only
+    # (the kernel every d<=2 path dispatches to on both backends)
+    from skyline_tpu.ops.sweep2d import skyline_mask_sweep2
+
+    for n in [65536, 262144, 1048576]:
+        x2 = jnp.asarray(anti_correlated(rng, n, 2, 0, 10000))
+        v2 = jnp.ones((n,), bool)
+        np.asarray(skyline_mask_sweep2(x2, v2))
+        t = _median_time(lambda: np.asarray(skyline_mask_sweep2(x2, v2)), reps)
+        results[f"skyline_mask_sweep2/n={n}/d=2"] = {
+            "ms": round(t * 1000, 2),
+        }
 
 
 def bench_flush_step(reps: int, d: int, results: dict) -> None:

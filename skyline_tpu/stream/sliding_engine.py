@@ -71,7 +71,13 @@ def _slide_step_batched(
     The meshed path keeps the pure-XLA scan kernels so GSPMD can partition
     the P axis without a shard_map (module docstring).
     """
-    if use_pallas:
+    if rings.shape[-1] <= 2:
+        # d <= 2: sort-sweep (ops/sweep2d.py) beats both pairwise kernels
+        # on every backend; vmaps cleanly over the partition axis
+        from skyline_tpu.ops.sweep2d import skyline_mask_sweep
+
+        mask = skyline_mask_sweep
+    elif use_pallas:
         from skyline_tpu.ops.pallas_dominance import skyline_mask_pallas
         from skyline_tpu.ops.sfs import pallas_interpret
 
