@@ -65,6 +65,16 @@ import numpy as np
 REFERENCE_TUPLES_PER_SEC = 1400.0  # 4-D/1M anchor, see module docstring
 
 
+def rank_cascade_stamp() -> bool:
+    """Artifact provenance for the rank-cascade dispatch decision — read
+    from the single source of truth (``ops.dispatch.rank_cascade``) instead
+    of re-reading SKYLINE_RANK_CASCADE with a duplicated default that can
+    silently drift from the dispatcher's (ADVICE.md round 5)."""
+    from skyline_tpu.ops.dispatch import rank_cascade
+
+    return rank_cascade()
+
+
 # --------------------------------------------------------------------------
 # worker: the measured benchmark (runs in a child process)
 # --------------------------------------------------------------------------
@@ -83,6 +93,108 @@ def run_window(cfg, ids, x, required, tracer=None):
     (result,) = eng.poll_results()
     dt = time.perf_counter() - t0
     return dt, result
+
+
+def serve_leg(d: int, algo: str) -> dict:
+    """Serving-plane microbenchmark: read latency p50/p99 and shed rate.
+
+    Builds a small engine + snapshot store + the serve HTTP stack
+    in-process, publishes one snapshot, then (a) hammers GET /skyline from
+    ``BENCH_SERVE_READERS`` concurrent reader threads against an unlimited
+    admission controller for the latency percentiles, and (b) replays a
+    burst against a rate-limited controller to measure explicit load
+    shedding (429 + Retry-After). Throughput here is reads served per
+    second, not tuples ingested. Env knobs: BENCH_SERVE_N (window rows,
+    default 65536), BENCH_SERVE_READERS (default 32), BENCH_SERVE_READS
+    (per reader, default 25), BENCH_SERVE_POINTS=1 (full-payload reads
+    instead of metadata-only).
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from skyline_tpu.serve import (
+        AdmissionController,
+        SkylineServer,
+        SnapshotStore,
+    )
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.workload.generators import anti_correlated
+
+    n = int(os.environ.get("BENCH_SERVE_N", 65536))
+    readers = int(os.environ.get("BENCH_SERVE_READERS", 32))
+    reads_each = int(os.environ.get("BENCH_SERVE_READS", 25))
+    points = "1" if os.environ.get("BENCH_SERVE_POINTS") == "1" else "0"
+    rng = np.random.default_rng(1)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, algo=algo, dims=d, domain_max=10000.0,
+                     flush_policy="lazy")
+    )
+    store = SnapshotStore()
+    eng.attach_snapshots(store)
+    eng.process_records(
+        np.arange(n, dtype=np.int64), anti_correlated(rng, n, d, 0, 10000)
+    )
+    eng.process_trigger("bench-serve,0")
+    eng.poll_results()
+    snap = store.latest()
+
+    def hammer(server, total, threads, lats, codes):
+        url = (
+            f"http://127.0.0.1:{server.port}/skyline"
+            f"?points={points}&max_age_ms=600000"
+        )
+        per = total // threads
+
+        def reader():
+            for _ in range(per):
+                t0 = time.perf_counter()
+                try:
+                    with urllib.request.urlopen(url, timeout=10) as r:
+                        r.read()
+                        codes.append(r.status)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+                lats.append((time.perf_counter() - t0) * 1000.0)
+
+        ts = [threading.Thread(target=reader) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    # (a) latency under concurrency, no admission limit
+    lats: list[float] = []
+    codes: list[int] = []
+    srv = SkylineServer(store, admission=AdmissionController(), port=0)
+    t0 = time.perf_counter()
+    hammer(srv, readers * reads_each, readers, lats, codes)
+    wall_s = time.perf_counter() - t0
+    srv.close()
+    # (b) shed behavior against a deliberately tight token bucket
+    shed_codes: list[int] = []
+    srv = SkylineServer(
+        store,
+        admission=AdmissionController(read_rate=500.0, read_burst=64),
+        port=0,
+    )
+    hammer(srv, readers * reads_each, readers, [], shed_codes)
+    srv.close()
+    shed = sum(1 for c in shed_codes if c == 429)
+    return {
+        "read_p50_ms": round(float(np.percentile(lats, 50)), 2),
+        "read_p99_ms": round(float(np.percentile(lats, 99)), 2),
+        "reads_ok": sum(1 for c in codes if c == 200),
+        "reads_per_sec": round(len(lats) / wall_s, 1),
+        "readers": readers,
+        "reads_per_reader": reads_each,
+        "payload_points": points == "1",
+        "snapshot_size": snap.size if snap is not None else 0,
+        "window_n": n,
+        "shed_burst_total": len(shed_codes),
+        "shed_429": shed,
+        "shed_rate": round(shed / max(1, len(shed_codes)), 3),
+    }
 
 
 def child_main(backend: str) -> None:
@@ -184,6 +296,15 @@ def child_main(backend: str) -> None:
     p50_s = float(np.percentile(lats, 50))
     tuples_per_sec = n / p50_s
     real_backend = jax.default_backend()
+    # serving-plane leg: read-side latency + shed behavior (BENCH_SERVE=0
+    # to skip). Never allowed to kill the ingest measurement above.
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            serve = serve_leg(d, algo)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            serve = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        serve = {"skipped": True}
     print(
         json.dumps(
             {
@@ -204,7 +325,8 @@ def child_main(backend: str) -> None:
                 "algo": algo,
                 "skyline_size_p50": int(np.median(sky_sizes)),
                 "flush_policy": cfg.flush_policy,
-                "rank_cascade": os.environ.get("SKYLINE_RANK_CASCADE", "0") != "0",
+                "rank_cascade": rank_cascade_stamp(),
+                "serve": serve,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",

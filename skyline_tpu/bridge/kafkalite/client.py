@@ -412,7 +412,12 @@ class KafkaLiteConsumer:
                 if abs_off < offset:
                     continue
                 target = out if len(out) < max_records else self._pending
-                target.append((value or b"").decode("utf-8"))
+                # errors="replace", not strict: a non-UTF-8 value must
+                # degrade to a dropped/malformed record downstream exactly
+                # like poll_arrays() counts it — not raise and kill the
+                # consume loop while the array plane survives the same
+                # record (ADVICE.md round 5)
+                target.append((value or b"").decode("utf-8", errors="replace"))
                 self._offset = abs_off + 1
         return out
 

@@ -36,6 +36,8 @@ class SkylineWorker:
         emit_per_slide: bool = False,
         max_drain_polls: int = 256,
         tracer=None,
+        serve_port: int | None = None,
+        serve_config=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``stats_port``: serve
@@ -46,6 +48,13 @@ class SkylineWorker:
         ``max_drain_polls``: cap on trigger-pending data re-polls per step
         (see ``step``); at the 65536-row default poll size the default cap
         drains up to ~16.7M rows before a trigger is applied anyway.
+        ``serve_port``: start the query-serving plane (``serve/``) on this
+        port (0 picks a free one; None disables): the engine publishes
+        every completed global skyline as a versioned snapshot, and
+        ``GET /skyline`` / ``POST /query`` / ``GET /deltas`` serve reads,
+        forced merges, and delta catch-up with admission control.
+        ``serve_config``: a ``serve.ServeConfig`` overriding the admission
+        and ring knobs (its ``port`` is overridden by ``serve_port``).
         ``tracer``: optional ``metrics.tracing.Tracer``; by default the
         worker traces its own loop (transport poll / parse / engine phases)
         with ``sync_device=False`` so the breakdown is observable in
@@ -79,6 +88,42 @@ class SkylineWorker:
         self._data = bus.consumer(input_topic, from_beginning=True)
         self._queries = bus.consumer(query_topic, from_beginning=False)
         self.results_emitted = 0
+        self.serve_server = None
+        self._serve_bridge = None
+        if serve_port is not None:
+            from skyline_tpu.serve import (
+                DeltaRing,
+                QueryBridge,
+                ServeConfig,
+                SkylineServer,
+                SnapshotStore,
+            )
+
+            scfg = serve_config if serve_config is not None else ServeConfig()
+            store = SnapshotStore(history=scfg.history)
+            ring = DeltaRing(store, capacity=scfg.delta_ring)
+            self.engine.attach_snapshots(store)
+            self._serve_bridge = QueryBridge()
+            try:
+                self.serve_server = SkylineServer(
+                    store,
+                    deltas=ring,
+                    admission=scfg.admission(),
+                    stats_cb=self.stats,
+                    bridge=self._serve_bridge,
+                    port=serve_port,
+                    host=scfg.host,
+                )
+            except OSError as e:
+                # like /stats: the serving plane is optional — a port
+                # conflict must not take the ingest plane down
+                self.engine.snapshots = None
+                self._serve_bridge = None
+                print(
+                    f"skyline worker: serve port {serve_port} unavailable "
+                    f"({e}); continuing without the serving plane",
+                    file=sys.stderr,
+                )
         self.stats_server = None
         if stats_port is not None:
             from skyline_tpu.metrics.httpstats import StatsServer
@@ -101,11 +146,16 @@ class SkylineWorker:
         out["phase_breakdown_ms"] = {
             k: round(v["total_ms"], 1) for k, v in self.tracer.report().items()
         }
+        if self.serve_server is not None:
+            out["serve"] = self.serve_server.admission.stats()
+            out["snapshot_store"] = self.serve_server.store.stats()
         return out
 
     def close(self) -> None:
         if self.stats_server is not None:
             self.stats_server.close()
+        if self.serve_server is not None:
+            self.serve_server.close()
 
     def _poll_data(self, max_records: int):
         """One data-topic poll as ``(ids, values, dropped, got)`` where
@@ -228,8 +278,16 @@ class SkylineWorker:
         with self.tracer.phase("worker/query"):
             for t in triggers:
                 self.engine.process_trigger(t)
+            if self._serve_bridge is not None:
+                # forced consistency merges from POST /query run on this
+                # thread, after bus triggers — the engine stays single-owner
+                self._serve_bridge.inject(self.engine)
             self.engine.check_timeouts()
-        for result in self.engine.poll_results():
+        results = self.engine.poll_results()
+        if self._serve_bridge is not None:
+            # serve-plane results return to their HTTP waiters, not the bus
+            results = self._serve_bridge.fulfill(results)
+        for result in results:
             self.bus.produce(self.output_topic, format_result(result))
             self.results_emitted += 1
             self._report_phases()
@@ -300,11 +358,14 @@ def main(argv=None):
         slide=cfg.slide,
         emit_per_slide=cfg.emit_per_slide,
         max_drain_polls=cfg.max_drain_polls,
+        serve_port=cfg.serve_port if cfg.serve_port >= 0 else None,
+        serve_config=cfg.serve_config() if cfg.serve_port >= 0 else None,
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
         f"dims={cfg.dims} broker={cfg.bootstrap} mesh={cfg.mesh or 'off'}"
-        + (f" stats=:{worker.stats_server.port}" if worker.stats_server else ""),
+        + (f" stats=:{worker.stats_server.port}" if worker.stats_server else "")
+        + (f" serve=:{worker.serve_server.port}" if worker.serve_server else ""),
         file=sys.stderr,
     )
     try:

@@ -19,6 +19,30 @@ import csv
 import json
 import os
 import sys
+import threading
+
+
+class Counters:
+    """Thread-safe named monotonic counters — the serving plane's metric
+    surface (shed / queue-depth / staleness counts, ``serve/admission.py``),
+    snapshotted into ``/stats`` and the bench artifact. Deliberately tiny:
+    ``inc`` on hot paths is one lock + one dict add."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
 
 CSV_HEADERS = [
     "QueryID",

@@ -343,9 +343,11 @@ extern "C" int64_t sky_parse_recordbatches(
         for (int64_t i = 0; i < n; ++i) {
             int64_t rec_len, off_delta, klen, vlen, tmp;
             if (!read_zigzag(q, qe, rec_len)) return -4;
+            // validate BEFORE forming rec_end: q + rec_len with a negative
+            // or oversized rec_len from a corrupt varint is out-of-range
+            // pointer arithmetic (UB) even if never dereferenced
+            if (rec_len <= 0 || rec_len > qe - q) return -4;
             const uint8_t* rec_end = q + rec_len;
-            if (rec_len < 0 || rec_end > qe) return -4;
-            if (q >= rec_end) return -4;
             ++q;  // attributes
             if (!read_zigzag(q, rec_end, tmp)) return -4;  // timestampDelta
             if (!read_zigzag(q, rec_end, off_delta)) return -4;

@@ -1,0 +1,32 @@
+"""Query-serving plane: versioned snapshots, delta subscriptions, admission.
+
+The streaming engine maintains the skyline; this package turns the
+maintained set into a read-heavy service (the read/maintain split of
+"Computing Skylines on Distributed Data", PAPERS.md): the engine publishes
+each completed global skyline as an immutable versioned snapshot
+(``snapshot.SnapshotStore``), readers are served lock-free from the latest
+published version under a client staleness bound, subscribers catch up on
+what entered/left between versions (``deltas.DeltaRing``), and the
+expensive forced-merge path is admission-controlled with explicit load
+shedding (``admission``). ``server.SkylineServer`` exposes all of it over a
+stdlib asyncio HTTP server; ``bridge/worker.py --serve <port>`` wires it
+into the worker loop.
+"""
+
+from skyline_tpu.serve.admission import AdmissionController, QueryGate, TokenBucket
+from skyline_tpu.serve.deltas import DeltaRing, snapshot_delta
+from skyline_tpu.serve.server import QueryBridge, ServeConfig, SkylineServer
+from skyline_tpu.serve.snapshot import Snapshot, SnapshotStore
+
+__all__ = [
+    "AdmissionController",
+    "DeltaRing",
+    "QueryBridge",
+    "QueryGate",
+    "ServeConfig",
+    "SkylineServer",
+    "Snapshot",
+    "SnapshotStore",
+    "TokenBucket",
+    "snapshot_delta",
+]

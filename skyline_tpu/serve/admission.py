@@ -1,0 +1,122 @@
+"""Admission control and backpressure for the serving plane.
+
+Two distinct costs, two distinct limiters:
+
+- Snapshot reads (``GET /skyline``, ``GET /deltas``) are cheap — one
+  lock-free reference load — but unbounded fan-in is still unbounded
+  work (JSON encoding, socket writes). A token bucket rate-limits them;
+  exhaustion sheds with 429 + Retry-After computed from the refill rate.
+- Forced consistency merges (``POST /query``) are the expensive path (a
+  full engine merge each). A concurrency gate bounds in-flight + queued
+  requests and every admitted request carries a deadline; over-bound
+  requests shed immediately (429) instead of growing an invisible queue.
+
+Shed / queue-depth / staleness counts go through
+``metrics.collector.Counters`` so ``/stats`` and the bench artifact report
+the same numbers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from skyline_tpu.metrics.collector import Counters
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s, ``burst`` capacity.
+
+    ``rate <= 0`` disables limiting (every acquire succeeds). ``try_acquire``
+    returns ``(admitted, retry_after_s)`` — ``retry_after_s`` is how long
+    until one token exists again, the 429 Retry-After value.
+    """
+
+    def __init__(self, rate: float, burst: int):
+        self.rate = float(rate)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: int = 1) -> tuple[bool, float]:
+        if self.rate <= 0:
+            return True, 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                float(self.burst), self._tokens + (now - self._stamp) * self.rate
+            )
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            return False, max(0.01, (n - self._tokens) / self.rate)
+
+
+class QueryGate:
+    """Concurrency limiter + bounded queue for the expensive query path.
+
+    At most ``max_concurrent`` queries execute while up to ``max_queue``
+    more wait; anything beyond that sheds immediately. ``enter`` returns
+    True when admitted (caller MUST ``leave()`` when done, success or not).
+    """
+
+    def __init__(self, max_concurrent: int, max_queue: int, counters: Counters):
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.max_queue = max(0, int(max_queue))
+        self._active = 0
+        self._lock = threading.Lock()
+        self._counters = counters
+
+    def enter(self) -> bool:
+        with self._lock:
+            if self._active >= self.max_concurrent + self.max_queue:
+                self._counters.inc("queries_shed")
+                return False
+            self._active += 1
+            self._counters.inc("queries_admitted")
+            return True
+
+    def leave(self) -> None:
+        with self._lock:
+            self._active = max(0, self._active - 1)
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._active
+
+
+class AdmissionController:
+    """The serving plane's policy bundle: read bucket + query gate + counters."""
+
+    def __init__(
+        self,
+        read_rate: float = 0.0,  # tokens/s; 0 = unlimited
+        read_burst: int = 256,
+        max_concurrent_queries: int = 2,
+        max_query_queue: int = 8,
+        query_deadline_ms: float = 10_000.0,
+        counters: Counters | None = None,
+    ):
+        self.counters = counters if counters is not None else Counters()
+        self.reads = TokenBucket(read_rate, read_burst)
+        self.queries = QueryGate(
+            max_concurrent_queries, max_query_queue, self.counters
+        )
+        self.query_deadline_ms = float(query_deadline_ms)
+
+    def admit_read(self) -> tuple[bool, float]:
+        ok, retry = self.reads.try_acquire()
+        if ok:
+            self.counters.inc("reads_admitted")
+        else:
+            self.counters.inc("reads_shed")
+        return ok, retry
+
+    def stats(self) -> dict:
+        out = self.counters.snapshot()
+        out["query_depth"] = self.queries.depth
+        out["query_deadline_ms"] = self.query_deadline_ms
+        return out

@@ -1,0 +1,155 @@
+"""Delta computation between consecutive skyline snapshots.
+
+A subscriber that has version V and wants the head doesn't need the full
+snapshot again — skylines evolve slowly relative to their size (most
+points survive each merge), so the (entered, left) set difference is the
+cheap catch-up currency. Snapshots don't carry tuple ids (the engine's
+device buffers hold values only — skyline membership is a property of the
+point, and duplicates merge), so rows are keyed by their byte image: each
+(d,) float32 row viewed as one opaque void scalar, which numpy sorts and
+set-intersects with memcmp — the vectorized path, no per-row Python
+objects.
+
+``DeltaRing`` subscribes to a ``SnapshotStore`` and keeps the last
+``capacity`` per-transition deltas, so ``/deltas?since=V`` answers from the
+ring; a subscriber that fell further behind than the ring gets a "gone"
+signal and re-baselines from a full snapshot read.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+def _row_keys(points: np.ndarray) -> np.ndarray:
+    """(n, d) float32 -> (n,) void keys (one memcmp-comparable scalar/row)."""
+    pts = np.ascontiguousarray(points, dtype=np.float32)
+    if pts.shape[0] == 0:
+        return np.empty(0, dtype=np.dtype((np.void, max(pts.shape[1], 1) * 4)))
+    return pts.view(np.dtype((np.void, pts.shape[1] * pts.itemsize))).reshape(-1)
+
+
+def snapshot_delta(old_points, new_points):
+    """(entered, left) between two point sets, vectorized on void row-keys.
+
+    entered = rows of ``new`` absent from ``old``; left = rows of ``old``
+    absent from ``new``. Duplicate rows within a set collapse (a skyline is
+    a set; the engine never emits duplicates, but the delta law shouldn't
+    depend on it).
+    """
+    old = np.ascontiguousarray(old_points, dtype=np.float32)
+    new = np.ascontiguousarray(new_points, dtype=np.float32)
+    if old.shape[0] == 0:
+        return np.unique(new, axis=0) if new.shape[0] else new, old
+    if new.shape[0] == 0:
+        return new, np.unique(old, axis=0)
+    ok, nk = _row_keys(old), _row_keys(new)
+    entered = new[~np.isin(nk, ok)]
+    left = old[~np.isin(ok, nk)]
+    if entered.shape[0]:
+        entered = np.unique(entered, axis=0)
+    if left.shape[0]:
+        left = np.unique(left, axis=0)
+    return entered, left
+
+
+class Delta:
+    """One published transition: what changed going from_version -> to_version."""
+
+    __slots__ = ("from_version", "to_version", "entered", "left")
+
+    def __init__(self, from_version, to_version, entered, left):
+        self.from_version = from_version
+        self.to_version = to_version
+        self.entered = entered
+        self.left = left
+
+
+class DeltaRing:
+    """Bounded ring of recent snapshot transitions.
+
+    Attach with ``ring = DeltaRing(store)`` — it subscribes to the store's
+    publish hook and computes each transition's delta on the publishing
+    thread (one vectorized set-diff per publish). ``since(v)`` merges the
+    transitions v -> head into one net (entered, left) pair: a point that
+    entered and then left inside the span cancels out, so the merge result
+    is exactly the set difference between snapshot v and the head.
+    """
+
+    def __init__(self, store=None, capacity: int = 128):
+        self._ring: deque[Delta] = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.head_version = 0
+        if store is not None:
+            store.on_publish(self.on_publish)
+
+    def on_publish(self, prev, snap) -> None:
+        entered, left = snapshot_delta(
+            prev.points if prev is not None else np.empty((0, snap.points.shape[1]), np.float32),
+            snap.points,
+        )
+        with self._lock:
+            self._ring.append(
+                Delta(prev.version if prev is not None else 0, snap.version, entered, left)
+            )
+            self.head_version = snap.version
+
+    @property
+    def oldest_since(self) -> int | None:
+        """The smallest ``since`` the ring can still answer (None = empty)."""
+        with self._lock:
+            return self._ring[0].from_version if self._ring else None
+
+    def since(self, version: int):
+        """Net (entered, left, to_version) from ``version`` to the head.
+
+        Returns None when ``version`` fell behind the ring (subscriber must
+        re-baseline from a snapshot). ``version >= head`` returns empty
+        arrays — the caller is current.
+        """
+        with self._lock:
+            transitions = [t for t in self._ring if t.from_version >= version]
+            head = self.head_version
+            covered = bool(self._ring) and self._ring[0].from_version <= version
+        if version >= head:
+            return (
+                np.empty((0, 0), np.float32),
+                np.empty((0, 0), np.float32),
+                head,
+            )
+        if not covered:
+            return None
+        # merge transitions oldest-first: membership flips cancel pairwise
+        state: dict[bytes, tuple[int, np.ndarray]] = {}
+        for t in transitions:
+            for row in t.entered:
+                k = row.tobytes()
+                if k in state and state[k][0] < 0:
+                    del state[k]  # left earlier in the span: net no-op
+                else:
+                    state[k] = (1, row)
+            for row in t.left:
+                k = row.tobytes()
+                if k in state and state[k][0] > 0:
+                    del state[k]  # entered earlier in the span: net no-op
+                else:
+                    state[k] = (-1, row)
+        entered = [r for s, r in state.values() if s > 0]
+        left = [r for s, r in state.values() if s < 0]
+        d = transitions[0].entered.shape[1] if transitions and transitions[0].entered.ndim == 2 else 0
+        stack = lambda rows: (  # noqa: E731 — tiny local shaping helper
+            np.stack(rows) if rows else np.empty((0, d), np.float32)
+        )
+        return stack(entered), stack(left), head
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "ring_depth": len(self._ring),
+                "ring_capacity": self._ring.maxlen,
+                "head_version": self.head_version,
+                "oldest_since": self._ring[0].from_version if self._ring else None,
+            }

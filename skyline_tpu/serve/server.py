@@ -1,0 +1,496 @@
+"""Asyncio HTTP server for the query-serving plane (stdlib only).
+
+The read-heavy counterpart of ``metrics/httpstats.py``: that module serves
+operator observability; this one serves the skyline itself. Endpoints:
+
+  GET  /skyline   snapshot read — latest published version, lock-free.
+                  Query params: ``max_age_ms`` / ``max_version_lag``
+                  (staleness bound; violating it is a 503 unless
+                  ``allow_stale=1``), ``refresh=1`` (a stale read fires a
+                  refresh merge through the worker instead of blocking on
+                  one), ``points=0`` (headers only), ``format=csv`` (the
+                  wire.py data-plane line format instead of JSON).
+  POST /query     force a fresh consistency merge (reference-parity
+                  semantics: an immediate trigger through the engine's
+                  query plane) — admission-controlled, deadline-bounded.
+  GET  /deltas    ``?since=<version>``: what entered/left the skyline
+                  between that version and the head, from the bounded
+                  delta ring; 410 Gone once ``since`` fell behind the ring
+                  (re-baseline with GET /skyline).
+  GET  /healthz   readiness probe.
+  GET  /stats     worker + engine counters plus serve-plane counters.
+
+Requests never touch the engine: reads come off the ``SnapshotStore``;
+forced queries cross to the worker thread through ``QueryBridge`` (the
+worker loop drains it between poll cycles), so the engine stays
+single-threaded. Load shedding is explicit: 429 + Retry-After from the
+admission controller, never an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from collections import deque
+from urllib.parse import parse_qs, urlsplit
+
+from skyline_tpu.serve.admission import AdmissionController
+
+_MAX_HEADER = 16_384
+_MAX_BODY = 1_048_576
+
+
+class ServeConfig:
+    """Knob bundle for the serving plane (mirrored by ``--serve-*`` flags)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        read_rate: float = 0.0,  # snapshot-read tokens/s; 0 = unlimited
+        read_burst: int = 256,
+        max_concurrent_queries: int = 2,
+        max_query_queue: int = 8,
+        query_deadline_ms: float = 10_000.0,
+        delta_ring: int = 128,
+        history: int = 64,
+    ):
+        self.port = port
+        self.host = host
+        self.read_rate = read_rate
+        self.read_burst = read_burst
+        self.max_concurrent_queries = max_concurrent_queries
+        self.max_query_queue = max_query_queue
+        self.query_deadline_ms = query_deadline_ms
+        self.delta_ring = delta_ring
+        self.history = history
+
+    def admission(self, counters=None) -> AdmissionController:
+        return AdmissionController(
+            read_rate=self.read_rate,
+            read_burst=self.read_burst,
+            max_concurrent_queries=self.max_concurrent_queries,
+            max_query_queue=self.max_query_queue,
+            query_deadline_ms=self.query_deadline_ms,
+            counters=counters,
+        )
+
+
+class _PendingQuery:
+    __slots__ = ("qid", "event", "result")
+
+    def __init__(self, qid: str):
+        self.qid = qid
+        self.event = threading.Event()
+        self.result = None
+
+    def wait(self, timeout_s: float) -> bool:
+        return self.event.wait(timeout_s)
+
+
+class QueryBridge:
+    """Hands forced queries from HTTP threads to the engine-owner thread.
+
+    HTTP side: ``submit()`` returns a pending handle to wait on. Engine
+    side (the worker loop): ``inject(engine)`` turns submissions into
+    immediate triggers, ``fulfill(results)`` routes the engine's completed
+    results back to their waiters and returns the non-serve leftovers
+    (which the worker emits to the output topic as before). Forced-query
+    qids are namespaced so they can never collide with bus triggers.
+    """
+
+    PREFIX = "__serve-"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._to_inject: deque[_PendingQuery] = deque()
+        self._awaiting: dict[str, _PendingQuery] = {}
+
+    def submit(self) -> _PendingQuery:
+        with self._lock:
+            self._seq += 1
+            p = _PendingQuery(f"{self.PREFIX}{self._seq}")
+            self._to_inject.append(p)
+            return p
+
+    def inject(self, engine) -> int:
+        """Dispatch queued submissions as immediate (required=0) triggers —
+        reference-parity consistency-merge semantics. Engine thread only."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._to_inject:
+                    return n
+                p = self._to_inject.popleft()
+                self._awaiting[p.qid] = p
+            engine.process_trigger(f"{p.qid},0")
+            n += 1
+
+    def fulfill(self, results: list[dict]) -> list[dict]:
+        """Route completed serve queries to their waiters; return the rest."""
+        out = []
+        for r in results:
+            qid = str(r.get("query_id", ""))
+            if qid.startswith(self.PREFIX):
+                with self._lock:
+                    p = self._awaiting.pop(qid, None)
+                if p is not None:
+                    p.result = r
+                    p.event.set()
+            else:
+                out.append(r)
+        return out
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._to_inject) + len(self._awaiting)
+
+
+class SkylineServer:
+    """The serving-plane HTTP front end (asyncio loop on a daemon thread)."""
+
+    def __init__(
+        self,
+        store,
+        deltas=None,
+        admission: AdmissionController | None = None,
+        stats_cb=None,
+        bridge: QueryBridge | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.store = store
+        self.deltas = deltas
+        self.admission = admission if admission is not None else AdmissionController()
+        self.stats_cb = stats_cb
+        self.bridge = bridge
+        self._loop = asyncio.new_event_loop()
+        self._server = None
+        self._startup_error: BaseException | None = None
+        self.port = None
+        ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(host, port, ready), daemon=True
+        )
+        self._thread.start()
+        ready.wait(timeout=10)
+        if self._startup_error is not None:
+            raise self._startup_error
+
+    def _run(self, host, port, ready):
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._server = self._loop.run_until_complete(
+                asyncio.start_server(self._handle, host, port)
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+        except BaseException as e:  # surfaced to __init__
+            self._startup_error = e
+            ready.set()
+            return
+        ready.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._server.close()
+            self._loop.run_until_complete(self._server.wait_closed())
+            self._loop.close()
+
+    def close(self) -> None:
+        if self._startup_error is not None:
+            return
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=10)
+
+    # -- request plumbing --------------------------------------------------
+
+    async def _handle(self, reader, writer):
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=10
+                )
+            except (
+                asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError,
+                asyncio.TimeoutError,
+            ):
+                return
+            if len(head) > _MAX_HEADER:
+                await self._reply(writer, 431, {"error": "headers too large"})
+                return
+            lines = head.decode("latin-1").split("\r\n")
+            parts = lines[0].split(" ")
+            if len(parts) != 3:
+                await self._reply(writer, 400, {"error": "bad request line"})
+                return
+            method, target, _version = parts
+            headers = {}
+            for ln in lines[1:]:
+                if ":" in ln:
+                    k, _, v = ln.partition(":")
+                    headers[k.strip().lower()] = v.strip()
+            clen = int(headers.get("content-length", "0") or "0")
+            if clen > _MAX_BODY:
+                await self._reply(writer, 413, {"error": "body too large"})
+                return
+            if clen:
+                await reader.readexactly(clen)  # body currently unused
+            url = urlsplit(target)
+            params = {k: v[-1] for k, v in parse_qs(url.query).items()}
+            await self._route(writer, method, url.path, params)
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, writer, method, path, params):
+        if path == "/healthz":
+            await self._reply(
+                writer,
+                200,
+                {"ok": True, "published": self.store.published > 0},
+            )
+        elif path == "/stats" and method == "GET":
+            await self._reply(writer, 200, self._stats())
+        elif path == "/skyline" and method == "GET":
+            await self._skyline(writer, params)
+        elif path == "/deltas" and method == "GET":
+            await self._deltas(writer, params)
+        elif path == "/query" and method == "POST":
+            await self._query(writer)
+        else:
+            await self._reply(writer, 404, {"error": "not found"})
+
+    def _stats(self) -> dict:
+        try:
+            out = dict(self.stats_cb()) if self.stats_cb is not None else {}
+        except Exception as e:  # observability must not 500 the plane down
+            out = {"stats_error": str(e)}
+        out["serve"] = self.admission.stats()
+        out["snapshot_store"] = self.store.stats()
+        if self.deltas is not None:
+            out["delta_ring"] = self.deltas.stats()
+        if self.bridge is not None:
+            out["serve"]["bridge_depth"] = self.bridge.depth
+        return out
+
+    # -- endpoints ---------------------------------------------------------
+
+    async def _skyline(self, writer, params):
+        ok, retry = self.admission.admit_read()
+        if not ok:
+            await self._reply(
+                writer,
+                429,
+                {"error": "rate limited", "retry_after_s": round(retry, 3)},
+                retry_after=retry,
+            )
+            return
+        try:
+            max_age = _float_param(params, "max_age_ms")
+            max_lag = _int_param(params, "max_version_lag")
+        except ValueError as e:
+            await self._reply(writer, 400, {"error": str(e)})
+            return
+        rs = self.store.read(max_age_ms=max_age, max_version_lag=max_lag)
+        if rs is None:
+            await self._reply(
+                writer, 503, {"error": "no snapshot published yet"}
+            )
+            return
+        refresh_triggered = False
+        if not rs.fresh:
+            self.admission.counters.inc("stale_reads")
+            if params.get("refresh") == "1" and self.bridge is not None:
+                # fire the refresh merge, serve (or reject) without blocking
+                self.bridge.submit()
+                self.admission.counters.inc("refreshes_triggered")
+                refresh_triggered = True
+            if params.get("allow_stale") != "1":
+                self.admission.counters.inc("stale_rejected")
+                await self._reply(
+                    writer,
+                    503,
+                    {
+                        "error": "snapshot stale for requested bound",
+                        "version": rs.snapshot.version,
+                        "age_ms": round(rs.age_ms, 1),
+                        "version_lag": rs.version_lag,
+                        "refresh_triggered": refresh_triggered,
+                    },
+                )
+                return
+        self.admission.counters.inc("reads_served")
+        snap = rs.snapshot
+        if params.get("format") == "csv":
+            from skyline_tpu.bridge.wire import format_tuple_line
+
+            body = "\n".join(
+                format_tuple_line(i, row) for i, row in enumerate(snap.points)
+            ).encode()
+            await self._reply_raw(
+                writer,
+                200,
+                body,
+                "text/plain; charset=utf-8",
+                extra_headers={
+                    "X-Skyline-Version": str(snap.version),
+                    "X-Skyline-Digest": snap.digest,
+                    "X-Skyline-Size": str(snap.size),
+                },
+            )
+            return
+        doc = snap.to_doc(include_points=params.get("points") != "0")
+        doc["age_ms"] = round(rs.age_ms, 1)
+        doc["version_lag"] = rs.version_lag
+        doc["stale"] = not rs.fresh
+        if refresh_triggered:
+            doc["refresh_triggered"] = True
+        await self._reply(writer, 200, doc)
+
+    async def _deltas(self, writer, params):
+        ok, retry = self.admission.admit_read()
+        if not ok:
+            await self._reply(
+                writer,
+                429,
+                {"error": "rate limited", "retry_after_s": round(retry, 3)},
+                retry_after=retry,
+            )
+            return
+        if self.deltas is None:
+            await self._reply(writer, 503, {"error": "no delta ring attached"})
+            return
+        try:
+            since = _int_param(params, "since")
+        except ValueError as e:
+            await self._reply(writer, 400, {"error": str(e)})
+            return
+        if since is None:
+            await self._reply(writer, 400, {"error": "missing ?since=<version>"})
+            return
+        res = self.deltas.since(since)
+        if res is None:
+            self.admission.counters.inc("deltas_gone")
+            await self._reply(
+                writer,
+                410,
+                {
+                    "error": "version fell behind the delta ring",
+                    "since": since,
+                    "oldest_since": self.deltas.oldest_since,
+                    "hint": "re-baseline with GET /skyline",
+                },
+            )
+            return
+        entered, left, head = res
+        self.admission.counters.inc("deltas_served")
+        await self._reply(
+            writer,
+            200,
+            {
+                "from_version": since,
+                "to_version": head,
+                "count_entered": int(entered.shape[0]),
+                "count_left": int(left.shape[0]),
+                "entered": entered.tolist(),
+                "left": left.tolist(),
+            },
+        )
+
+    async def _query(self, writer):
+        if self.bridge is None:
+            await self._reply(
+                writer, 503, {"error": "no query plane attached"}
+            )
+            return
+        gate = self.admission.queries
+        if not gate.enter():
+            await self._reply(
+                writer,
+                429,
+                {"error": "query admission limit exceeded"},
+                retry_after=1.0,
+            )
+            return
+        try:
+            pending = self.bridge.submit()
+            deadline_s = self.admission.query_deadline_ms / 1000.0
+            done = await self._loop.run_in_executor(
+                None, pending.wait, deadline_s
+            )
+            if not done:
+                self.admission.counters.inc("queries_timed_out")
+                await self._reply(
+                    writer,
+                    503,
+                    {
+                        "error": "query deadline exceeded",
+                        "deadline_ms": self.admission.query_deadline_ms,
+                    },
+                )
+                return
+            self.admission.counters.inc("queries_served")
+            await self._reply(writer, 200, pending.result)
+        finally:
+            gate.leave()
+
+    # -- response helpers --------------------------------------------------
+
+    async def _reply(self, writer, code, doc, retry_after=None):
+        extra = (
+            {"Retry-After": str(max(1, int(retry_after + 0.999)))}
+            if retry_after is not None
+            else None
+        )
+        await self._reply_raw(
+            writer,
+            code,
+            json.dumps(doc).encode(),
+            "application/json",
+            extra_headers=extra,
+        )
+
+    async def _reply_raw(self, writer, code, body, ctype, extra_headers=None):
+        reason = {
+            200: "OK", 400: "Bad Request", 404: "Not Found",
+            410: "Gone", 413: "Payload Too Large", 429: "Too Many Requests",
+            431: "Request Header Fields Too Large", 503: "Service Unavailable",
+        }.get(code, "OK")
+        head = [
+            f"HTTP/1.1 {code} {reason}",
+            f"Content-Type: {ctype}",
+            f"Content-Length: {len(body)}",
+            "Connection: close",
+        ]
+        for k, v in (extra_headers or {}).items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+
+def _float_param(params, name):
+    v = params.get(name)
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"bad {name}: {v!r}")
+
+
+def _int_param(params, name):
+    v = params.get(name)
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"bad {name}: {v!r}")

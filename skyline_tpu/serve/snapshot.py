@@ -1,0 +1,214 @@
+"""Versioned skyline snapshot store — the serving plane's source of truth.
+
+The engine (``stream/engine.py`` / ``stream/sliding_engine.py``) publishes
+each completed global skyline here as an immutable, monotonically-versioned
+``Snapshot``; readers never touch the engine. A read serves the latest
+published version lock-free — publication is a single reference swap, and
+snapshots are frozen (read-only numpy arrays + a content digest stamped at
+publish, so a torn read is detectable as a digest mismatch, which the swap
+makes impossible to begin with).
+
+Staleness contract. Two client-specified bounds, both optional:
+
+- ``max_age_ms``: the snapshot's publish timestamp must be within this many
+  milliseconds of now.
+- ``max_version_lag``: the number of ingest advances (micro-batches the
+  engine has absorbed since the snapshot was cut — ``note_ingest`` calls)
+  must not exceed this. Lag 0 means "exact": nothing has entered the
+  engine since the snapshot. The engine bumps this counter from its data
+  plane, so the bound is enforceable without any device sync.
+
+A read that violates its bound is reported stale; the HTTP layer
+(``serve/server.py``) then either rejects it (503), serves it flagged
+(``allow_stale``), and/or fires a refresh merge instead of blocking on one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One immutable published global skyline."""
+
+    version: int
+    watermark_id: int  # max tuple id ingested when the snapshot was cut
+    timestamp_ms: float
+    points: np.ndarray  # (k, d) float32, read-only
+    digest: str  # sha1 of the points buffer, stamped at publish
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def size(self) -> int:
+        return int(self.points.shape[0])
+
+    def to_doc(self, include_points: bool = True) -> dict:
+        doc = {
+            "version": self.version,
+            "watermark_id": self.watermark_id,
+            "timestamp_ms": self.timestamp_ms,
+            "skyline_size": self.size,
+            "digest": self.digest,
+        }
+        doc.update(self.meta)
+        if include_points:
+            doc["points"] = self.points.tolist()
+        return doc
+
+
+def points_digest(points: np.ndarray) -> str:
+    """Content hash of a points buffer (row order included — snapshots are
+    published in the engine's canonical order, so equality is byte equality)."""
+    return hashlib.sha1(np.ascontiguousarray(points).tobytes()).hexdigest()
+
+
+class ReadStatus:
+    """Outcome of a bounded read: the snapshot plus why/whether it's fresh."""
+
+    __slots__ = ("snapshot", "fresh", "age_ms", "version_lag")
+
+    def __init__(self, snapshot, fresh, age_ms, version_lag):
+        self.snapshot = snapshot
+        self.fresh = fresh
+        self.age_ms = age_ms
+        self.version_lag = version_lag
+
+
+class SnapshotStore:
+    """Single-writer (the engine thread), many-reader snapshot store.
+
+    Writers call ``publish`` / ``note_ingest``; readers call ``latest`` /
+    ``read`` / ``get``. The read path takes no lock: ``_latest`` is swapped
+    atomically (one reference assignment) and every ``Snapshot`` is frozen.
+    ``history`` bounds the versions kept for ``get``-by-version catch-up
+    (the delta ring in ``serve/deltas.py`` subscribes via ``on_publish``).
+    """
+
+    def __init__(self, history: int = 64):
+        self._latest: Snapshot | None = None
+        self._history: deque[Snapshot] = deque(maxlen=max(1, history))
+        self._version = 0
+        self._advances = 0  # ingest advances since the last publish
+        self._stream_watermark = -1
+        self._write_lock = threading.Lock()
+        self._subscribers: list = []  # publish callbacks (delta ring, tests)
+        self.published = 0
+
+    # -- writer side (engine thread) --------------------------------------
+
+    def on_publish(self, callback) -> None:
+        """Register ``callback(prev: Snapshot | None, new: Snapshot)`` to run
+        synchronously on the publishing thread after each swap."""
+        self._subscribers.append(callback)
+
+    def note_ingest(self, watermark_id: int | None = None, batches: int = 1) -> None:
+        """The engine absorbed new data: the latest snapshot is now one
+        (more) version-lag unit behind. Cheap — two int updates."""
+        self._advances += batches
+        if watermark_id is not None and watermark_id > self._stream_watermark:
+            self._stream_watermark = watermark_id
+
+    def publish(
+        self,
+        points: np.ndarray,
+        watermark_id: int | None = None,
+        now_ms: float | None = None,
+        **meta,
+    ) -> Snapshot:
+        """Freeze ``points`` as the next version and swap it in."""
+        with self._write_lock:
+            pts = np.ascontiguousarray(points, dtype=np.float32)
+            if pts.base is None or pts is points:
+                pts = pts.copy()  # never alias the engine's buffer
+            pts.setflags(write=False)
+            self._version += 1
+            if watermark_id is None:
+                watermark_id = self._stream_watermark
+            snap = Snapshot(
+                version=self._version,
+                watermark_id=int(watermark_id),
+                timestamp_ms=_now_ms() if now_ms is None else now_ms,
+                points=pts,
+                digest=points_digest(pts),
+                meta=dict(meta),
+            )
+            prev = self._latest
+            self._history.append(snap)
+            self._advances = 0
+            self._latest = snap  # the atomic swap readers key off
+            self.published += 1
+        for cb in self._subscribers:
+            cb(prev, snap)
+        return snap
+
+    # -- reader side (any thread, lock-free) ------------------------------
+
+    def latest(self) -> Snapshot | None:
+        return self._latest
+
+    def get(self, version: int) -> Snapshot | None:
+        """A specific retained version (None once it ages out of history)."""
+        for snap in reversed(self._history):
+            if snap.version == version:
+                return snap
+        return None
+
+    @property
+    def head_version(self) -> int:
+        return self._version
+
+    @property
+    def version_lag(self) -> int:
+        """Ingest advances since the latest publish (0 = snapshot is exact)."""
+        return self._advances
+
+    @property
+    def stream_watermark(self) -> int:
+        return self._stream_watermark
+
+    def read(
+        self,
+        max_age_ms: float | None = None,
+        max_version_lag: int | None = None,
+        now_ms: float | None = None,
+    ) -> ReadStatus | None:
+        """Bounded read of the latest snapshot; None if nothing published."""
+        snap = self._latest  # one atomic load; everything below is frozen
+        if snap is None:
+            return None
+        now = _now_ms() if now_ms is None else now_ms
+        age_ms = max(0.0, now - snap.timestamp_ms)
+        lag = self._advances
+        fresh = True
+        if max_age_ms is not None and age_ms > max_age_ms:
+            fresh = False
+        if max_version_lag is not None and lag > max_version_lag:
+            fresh = False
+        return ReadStatus(snap, fresh, age_ms, lag)
+
+    def stats(self) -> dict:
+        snap = self._latest
+        return {
+            "head_version": self._version,
+            "published": self.published,
+            "version_lag": self._advances,
+            "stream_watermark": self._stream_watermark,
+            "history_depth": len(self._history),
+            "latest_size": snap.size if snap is not None else 0,
+            "latest_age_ms": (
+                round(max(0.0, _now_ms() - snap.timestamp_ms), 1)
+                if snap is not None
+                else None
+            ),
+        }

@@ -202,6 +202,17 @@ class SkylineEngine:
         self.dropped = 0
         self.prefiltered = 0
         self._midpoint_witness = False  # grid_prefilter safety latch
+        # serving plane (serve/snapshot.py): when attached, every completed
+        # global skyline publishes as an immutable versioned snapshot and
+        # every ingest micro-batch advances its staleness counter
+        self.snapshots = None
+
+    def attach_snapshots(self, store) -> None:
+        """Publish completed global skylines to ``store`` (a
+        ``serve.snapshot.SnapshotStore``). Costs nothing until attached;
+        once attached, query answers materialize their points even when
+        ``emit_skyline_points`` is off (the snapshot IS the read path)."""
+        self.snapshots = store
 
     # -- data plane -------------------------------------------------------
 
@@ -218,6 +229,9 @@ class SkylineEngine:
             now_ms = time.time() * 1000.0
         cfg = self.config
         self.records_in += values.shape[0]
+        if self.snapshots is not None:
+            # the latest snapshot is now one ingest advance behind
+            self.snapshots.note_ingest(int(ids.max()))
         if self.pset.device_ingest:
             # routing + barrier stats on device; host bookkeeping syncs only
             # when a pending query needs its barrier re-evaluated
@@ -417,6 +431,8 @@ class SkylineEngine:
             self.config.num_partitions,
         )
 
+        if self.snapshots is not None:
+            self.snapshots.publish(global_sky, query_id=q.qid)
         self._emit_result(
             q,
             skyline_size=int(global_sky.shape[0]),
@@ -479,10 +495,17 @@ class SkylineEngine:
         self.pset.flush_all()
         flush_wall_ms = (time.perf_counter_ns() - t0) / 1e6
         t1 = time.perf_counter_ns()
+        # an attached snapshot store needs the materialized points even when
+        # the result JSON omits them — the snapshot IS the serving read path
+        want_points = (
+            self.config.emit_skyline_points or self.snapshots is not None
+        )
         counts, surv, g, pts = self.pset.global_merge_stats(
-            emit_points=self.config.emit_skyline_points
+            emit_points=want_points
         )
         merge_ms = (time.perf_counter_ns() - t1) / 1e6
+        if self.snapshots is not None:
+            self.snapshots.publish(pts, query_id=q.qid)
 
         starts = [s for s in self.pset.start_time_ms if s is not None]
         map_finish = now_ms + flush_wall_ms

@@ -178,6 +178,12 @@ class SlidingEngine:
         }
         self._inflight: dict[str, _QueryState] = {}
         self._results: list[dict] = []
+        # serving plane (serve/snapshot.py) — same contract as SkylineEngine
+        self.snapshots = None
+
+    def attach_snapshots(self, store) -> None:
+        """Publish each answered window's global skyline to ``store``."""
+        self.snapshots = store
 
     def _put(self, arr):
         if self._sharding is not None:
@@ -194,6 +200,8 @@ class SlidingEngine:
         if now_ms is None:
             now_ms = time.time() * 1000.0
         self.records_in += values.shape[0]
+        if self.snapshots is not None:
+            self.snapshots.note_ingest(int(ids.max()))
         pos = 0
         n = values.shape[0]
         # now_ms advances through routing answers and slide closes: wall
@@ -438,6 +446,13 @@ class SlidingEngine:
         }
         if self.config.emit_skyline_points:
             result["skyline_points"] = global_sky.tolist()
+        if self.snapshots is not None:
+            self.snapshots.publish(
+                global_sky,
+                query_id=q.qid,
+                slides_closed=self._slides_closed,
+                window_filled=self._slides_closed >= self.k,
+            )
         self._results.append(result)
         self._inflight.pop(q.payload, None)
         return now

@@ -52,6 +52,16 @@ class JobConfig:
     # finite streams larger than max_drain_polls * poll size (~16.7M rows
     # at the defaults) so immediate triggers see the full ingest
     max_drain_polls: int = 256
+    # query-serving plane (skyline_tpu/serve): --serve <port> starts the
+    # snapshot/delta/query HTTP server (-1 = off; 0 picks a free port)
+    serve_port: int = -1
+    serve_read_rate: float = 0.0  # snapshot-read tokens/s (0 = unlimited)
+    serve_read_burst: int = 256
+    serve_max_queries: int = 2  # concurrent forced merges
+    serve_query_queue: int = 8  # queued forced merges beyond concurrent
+    serve_query_deadline_ms: float = 10_000.0
+    serve_delta_ring: int = 128  # retained snapshot transitions
+    serve_history: int = 64  # retained snapshot versions
 
     def __post_init__(self):
         if self.parallelism < 1:
@@ -90,6 +100,36 @@ class JobConfig:
         if self.max_drain_polls < 1:
             raise ValueError(
                 f"max_drain_polls must be >= 1, got {self.max_drain_polls}"
+            )
+        if self.serve_port < -1:
+            raise ValueError(
+                f"serve_port must be >= -1, got {self.serve_port}"
+            )
+        if self.serve_read_rate < 0:
+            raise ValueError(
+                f"serve_read_rate must be >= 0, got {self.serve_read_rate}"
+            )
+        if self.serve_read_burst < 1:
+            raise ValueError(
+                f"serve_read_burst must be >= 1, got {self.serve_read_burst}"
+            )
+        if self.serve_max_queries < 1:
+            raise ValueError(
+                f"serve_max_queries must be >= 1, got {self.serve_max_queries}"
+            )
+        if self.serve_query_queue < 0:
+            raise ValueError(
+                f"serve_query_queue must be >= 0, got {self.serve_query_queue}"
+            )
+        if self.serve_query_deadline_ms <= 0:
+            raise ValueError(
+                "serve_query_deadline_ms must be > 0, got "
+                f"{self.serve_query_deadline_ms}"
+            )
+        if self.serve_delta_ring < 1 or self.serve_history < 1:
+            raise ValueError(
+                "serve_delta_ring and serve_history must be >= 1, got "
+                f"{self.serve_delta_ring} / {self.serve_history}"
             )
         # the over-partitioning factor is owned by EngineConfig; validate
         # against it rather than a duplicated literal
@@ -135,6 +175,22 @@ class JobConfig:
             flush_policy=self.flush_policy,
             overlap_rows=self.overlap_rows,
             ingest=self.ingest,
+        )
+
+    def serve_config(self):
+        """The ``serve.ServeConfig`` this job's serve knobs describe (the
+        worker overrides its ``port`` with ``serve_port``)."""
+        from skyline_tpu.serve import ServeConfig
+
+        return ServeConfig(
+            port=max(0, self.serve_port),
+            read_rate=self.serve_read_rate,
+            read_burst=self.serve_read_burst,
+            max_concurrent_queries=self.serve_max_queries,
+            max_query_queue=self.serve_query_queue,
+            query_deadline_ms=self.serve_query_deadline_ms,
+            delta_ring=self.serve_delta_ring,
+            history=self.serve_history,
         )
 
     def build_mesh(self):
@@ -227,6 +283,41 @@ def parse_job_args(argv=None) -> JobConfig:
                     help="cap on trigger-pending data re-polls per step; "
                          "raise for finite streams larger than "
                          "max_drain_polls * 65536 rows")
+    ap.add_argument("--serve", type=int, dest="serve_port",
+                    default=_env_int("SERVE", defaults.serve_port),
+                    help="start the query-serving plane (snapshot reads, "
+                         "forced merges, delta catch-up) on this port "
+                         "(-1 = off, 0 = pick a free port)")
+    ap.add_argument("--serve-read-rate", type=float,
+                    default=_env_float("SERVE_READ_RATE",
+                                       defaults.serve_read_rate),
+                    help="snapshot-read token rate per second "
+                         "(0 = unlimited); exhaustion sheds with 429")
+    ap.add_argument("--serve-read-burst", type=int,
+                    default=_env_int("SERVE_READ_BURST",
+                                     defaults.serve_read_burst),
+                    help="snapshot-read token bucket capacity")
+    ap.add_argument("--serve-max-queries", type=int,
+                    default=_env_int("SERVE_MAX_QUERIES",
+                                     defaults.serve_max_queries),
+                    help="concurrent forced merges (POST /query)")
+    ap.add_argument("--serve-query-queue", type=int,
+                    default=_env_int("SERVE_QUERY_QUEUE",
+                                     defaults.serve_query_queue),
+                    help="queued forced merges beyond the concurrent cap; "
+                         "beyond that POST /query sheds with 429")
+    ap.add_argument("--serve-query-deadline-ms", type=float,
+                    default=_env_float("SERVE_QUERY_DEADLINE_MS",
+                                       defaults.serve_query_deadline_ms),
+                    help="deadline for an admitted forced merge")
+    ap.add_argument("--serve-delta-ring", type=int,
+                    default=_env_int("SERVE_DELTA_RING",
+                                     defaults.serve_delta_ring),
+                    help="snapshot transitions kept for /deltas catch-up")
+    ap.add_argument("--serve-history", type=int,
+                    default=_env_int("SERVE_HISTORY",
+                                     defaults.serve_history),
+                    help="snapshot versions retained in the store")
     a = ap.parse_args(argv)
     return JobConfig(
         parallelism=a.parallelism,
@@ -251,6 +342,14 @@ def parse_job_args(argv=None) -> JobConfig:
         slide=a.slide,
         emit_per_slide=a.emit_per_slide,
         max_drain_polls=a.max_drain_polls,
+        serve_port=a.serve_port,
+        serve_read_rate=a.serve_read_rate,
+        serve_read_burst=a.serve_read_burst,
+        serve_max_queries=a.serve_max_queries,
+        serve_query_queue=a.serve_query_queue,
+        serve_query_deadline_ms=a.serve_query_deadline_ms,
+        serve_delta_ring=a.serve_delta_ring,
+        serve_history=a.serve_history,
     )
 
 

@@ -1,0 +1,17 @@
+"""bench.py artifact provenance: the rank_cascade stamp in the bench JSON
+must track the dispatcher's single source of truth
+(``ops.dispatch.rank_cascade``), not a re-read of SKYLINE_RANK_CASCADE with
+a duplicated default that can silently drift (ADVICE.md round 5)."""
+
+import bench
+
+from skyline_tpu.ops import dispatch
+
+
+def test_rank_cascade_stamp_tracks_dispatch(monkeypatch):
+    monkeypatch.delenv("SKYLINE_RANK_CASCADE", raising=False)
+    assert bench.rank_cascade_stamp() is dispatch.rank_cascade() is False
+    monkeypatch.setenv("SKYLINE_RANK_CASCADE", "1")
+    assert bench.rank_cascade_stamp() is dispatch.rank_cascade() is True
+    monkeypatch.setenv("SKYLINE_RANK_CASCADE", "0")
+    assert bench.rank_cascade_stamp() is dispatch.rank_cascade() is False

@@ -382,3 +382,48 @@ def test_poll_arrays_drains_pending_from_mixed_use(broker):
     assert cons.position() == 500
     prod.close()
     cons.close()
+
+
+def test_poll_degrades_on_non_utf8_like_poll_arrays(broker):
+    """A non-UTF-8 value must come through poll() as a replacement-char
+    line (dropped as malformed by the downstream parser) instead of
+    UnicodeDecodeError killing the consume loop — the line plane degrades
+    identically to poll_arrays(), which counts the same record dropped
+    (ADVICE.md round 5)."""
+    from skyline_tpu.bridge.wire import parse_tuple_lines
+
+    prod = KafkaLiteProducer(broker.address)
+    prod.send("u8", "1,10,20")
+    prod.send("u8", b"2,\xff\xfe,30")  # invalid UTF-8 inside a value field
+    prod.send("u8", "3,40,50")
+    prod.flush()
+
+    cons = KafkaLiteConsumer("u8", broker.address)
+    got = []
+    for _ in range(20):
+        got.extend(cons.poll())  # must not raise
+        if len(got) >= 3:
+            break
+    assert got[0] == "1,10,20" and got[2] == "3,40,50"
+    assert "�" in got[1]  # degraded, not dropped silently at decode
+    ids, _vals, dropped = parse_tuple_lines(got, 2)
+    assert list(ids) == [1, 3] and dropped == 1
+
+    # the array plane sees the same shape: two survivors, one drop
+    c_arr = KafkaLiteConsumer("u8", broker.address)
+    if c_arr.poll_arrays(2) is None:
+        pytest.skip("native library unavailable")
+    c_arr.close()
+    c_arr = KafkaLiteConsumer("u8", broker.address)
+    a_ids = []
+    a_drop = 0
+    for _ in range(20):
+        i2, _v2, d2 = c_arr.poll_arrays(2)
+        a_ids.extend(i2.tolist())
+        a_drop += d2
+        if len(a_ids) + a_drop >= 3:
+            break
+    assert a_ids == [1, 3] and a_drop == 1
+    prod.close()
+    cons.close()
+    c_arr.close()

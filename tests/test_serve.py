@@ -1,0 +1,599 @@
+"""Query-serving plane: versioned snapshots, staleness bounds, deltas,
+admission control, and the worker-integrated HTTP surface.
+
+The acceptance test here is ``test_concurrent_readers_during_active_ingest``:
+>=32 reader threads hammering GET /skyline while the worker ingests and
+publishes, every response inside its staleness bound, every payload
+digest-verified (zero torn reads), versions monotone per reader — then the
+shed phase observes explicit 429s from a tight token bucket.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.bridge import MemoryBus, SkylineWorker
+from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+from skyline_tpu.ops import skyline_np
+from skyline_tpu.serve import (
+    AdmissionController,
+    DeltaRing,
+    QueryBridge,
+    ServeConfig,
+    SkylineServer,
+    SnapshotStore,
+    TokenBucket,
+    snapshot_delta,
+)
+from skyline_tpu.serve.snapshot import points_digest
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.workload.generators import (
+    anti_correlated,
+    correlated,
+    uniform,
+)
+
+
+def _get(url, timeout=10):
+    """(status, json_doc, headers) — HTTPError surfaces as its status."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.load(r), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+# --------------------------------------------------------------------------
+# snapshot store
+# --------------------------------------------------------------------------
+
+
+def test_snapshot_versions_are_monotonic(rng):
+    store = SnapshotStore(history=4)
+    assert store.latest() is None and store.read() is None
+    seen = []
+    for _ in range(6):
+        snap = store.publish(rng.uniform(0, 1, size=(8, 3)))
+        seen.append(snap.version)
+    assert seen == [1, 2, 3, 4, 5, 6]
+    assert store.latest().version == store.head_version == 6
+    # history is bounded: only the last 4 versions remain addressable
+    assert store.get(6).version == 6 and store.get(3).version == 3
+    assert store.get(1) is None and store.get(2) is None
+
+
+def test_snapshot_is_frozen_and_never_aliases_the_engine_buffer(rng):
+    store = SnapshotStore()
+    src = rng.uniform(0, 1, size=(5, 2)).astype(np.float32)
+    snap = store.publish(src)
+    src[:] = -1.0  # engine reuses its buffer; the snapshot must not move
+    assert float(snap.points.min()) >= 0.0
+    assert snap.digest == points_digest(snap.points)
+    with pytest.raises(ValueError):
+        snap.points[0, 0] = 99.0
+
+
+def test_staleness_bounds_age_and_version_lag(rng):
+    store = SnapshotStore()
+    store.publish(rng.uniform(0, 1, size=(4, 2)), now_ms=1000.0)
+    # fresh on both axes
+    rs = store.read(max_age_ms=500.0, max_version_lag=0, now_ms=1200.0)
+    assert rs.fresh and rs.age_ms == 200.0 and rs.version_lag == 0
+    # age bound violated
+    rs = store.read(max_age_ms=500.0, now_ms=2000.0)
+    assert not rs.fresh and rs.age_ms == 1000.0
+    # lag bound: each ingest advance puts the snapshot one unit behind
+    store.note_ingest(watermark_id=10)
+    store.note_ingest(watermark_id=20)
+    rs = store.read(max_version_lag=1, now_ms=1100.0)
+    assert not rs.fresh and rs.version_lag == 2
+    rs = store.read(max_version_lag=2, now_ms=1100.0)
+    assert rs.fresh
+    assert store.stream_watermark == 20
+    # a publish resets the lag: the new snapshot covers the ingested data
+    store.publish(rng.uniform(0, 1, size=(4, 2)), now_ms=1500.0)
+    rs = store.read(max_version_lag=0, now_ms=1500.0)
+    assert rs.fresh and rs.snapshot.watermark_id == 20
+    # no bound specified -> always fresh
+    assert store.read(now_ms=1e12).fresh
+
+
+# --------------------------------------------------------------------------
+# deltas
+# --------------------------------------------------------------------------
+
+
+def _brute_delta(old, new):
+    o = {tuple(r) for r in np.asarray(old, np.float32).tolist()}
+    n = {tuple(r) for r in np.asarray(new, np.float32).tolist()}
+    return n - o, o - n
+
+
+def _as_set(points):
+    return {tuple(r) for r in np.asarray(points, np.float32).tolist()}
+
+
+@pytest.mark.parametrize("gen", [uniform, correlated, anti_correlated])
+def test_snapshot_delta_matches_bruteforce_set_diff(rng, gen):
+    d = 3
+    x = gen(rng, 800, d, 0, 10000)
+    y = gen(rng, 800, d, 0, 10000)
+    old = skyline_np(x)
+    new = skyline_np(np.concatenate([x, y]))
+    entered, left = snapshot_delta(old, new)
+    want_entered, want_left = _brute_delta(old, new)
+    assert _as_set(entered) == want_entered
+    assert _as_set(left) == want_left
+    # identity and empty edges
+    e2, l2 = snapshot_delta(old, old)
+    assert e2.shape[0] == 0 and l2.shape[0] == 0
+    e3, l3 = snapshot_delta(np.empty((0, d), np.float32), new)
+    assert _as_set(e3) == _as_set(new) and l3.shape[0] == 0
+
+
+def test_delta_ring_merges_span_with_cancellation(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=16)
+    a = np.asarray([[1.0, 1.0], [2.0, 0.5]], np.float32)
+    b = np.asarray([[1.0, 1.0], [0.2, 3.0]], np.float32)  # 2,0.5 left
+    c = np.asarray([[1.0, 1.0], [2.0, 0.5]], np.float32)  # it came back
+    store.publish(a)
+    store.publish(b)
+    store.publish(c)
+    # v1 -> head: a == c, so the net delta must fully cancel
+    entered, left, head = ring.since(1)
+    assert head == 3 and entered.shape[0] == 0 and left.shape[0] == 0
+    # v2 -> head: exactly the set difference between b and c
+    entered, left, head = ring.since(2)
+    we, wl = _brute_delta(b, c)
+    assert _as_set(entered) == we and _as_set(left) == wl
+    # current or future subscriber: empty catch-up
+    e, l, h = ring.since(3)
+    assert h == 3 and e.shape[0] == 0 and l.shape[0] == 0
+
+
+def test_delta_ring_signals_gone_when_subscriber_falls_behind(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=2)
+    for _ in range(5):
+        store.publish(rng.uniform(0, 1, size=(6, 2)))
+    # ring holds transitions 3->4 and 4->5 only
+    assert ring.oldest_since == 3
+    assert ring.since(1) is None
+    assert ring.since(2) is None
+    got = ring.since(3)
+    assert got is not None and got[2] == 5
+    # the net merge still equals the direct v3 -> v5 set diff
+    we, wl = _brute_delta(store.get(3).points, store.get(5).points)
+    assert _as_set(got[0]) == we and _as_set(got[1]) == wl
+
+
+# --------------------------------------------------------------------------
+# admission control
+# --------------------------------------------------------------------------
+
+
+def test_token_bucket_sheds_past_burst_and_reports_retry_after():
+    tb = TokenBucket(rate=10.0, burst=3)
+    admitted = [tb.try_acquire()[0] for _ in range(5)]
+    assert admitted[:3] == [True, True, True]
+    assert admitted[3] is False
+    ok, retry = tb.try_acquire()
+    assert not ok and retry > 0
+    # unlimited bucket never sheds
+    assert all(TokenBucket(0.0, 1).try_acquire()[0] for _ in range(100))
+
+
+def test_query_gate_bounds_concurrency_plus_queue():
+    ctrl = AdmissionController(max_concurrent_queries=1, max_query_queue=1)
+    gate = ctrl.queries
+    assert gate.enter() and gate.enter()  # 1 active + 1 queued
+    assert not gate.enter()  # shed
+    assert ctrl.counters.get("queries_shed") == 1
+    gate.leave()
+    assert gate.enter()
+    assert gate.depth == 2
+
+
+# --------------------------------------------------------------------------
+# HTTP surface (store-level, no engine)
+# --------------------------------------------------------------------------
+
+
+def test_http_skyline_deltas_and_errors(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=2)
+    srv = SkylineServer(store, deltas=ring, port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, doc, _ = _get(f"{base}/healthz")
+        assert code == 200 and doc["ok"] and not doc["published"]
+        # nothing published yet
+        code, doc, _ = _get(f"{base}/skyline")
+        assert code == 503
+        pts = skyline_np(uniform(rng, 300, 2, 0, 10000))
+        store.publish(pts, watermark_id=299)
+        code, doc, _ = _get(f"{base}/skyline")
+        assert code == 200 and doc["version"] == 1
+        assert doc["skyline_size"] == pts.shape[0]
+        got = np.asarray(doc["points"], np.float32)
+        assert points_digest(got) == doc["digest"]
+        # metadata-only read
+        code, doc, _ = _get(f"{base}/skyline?points=0")
+        assert code == 200 and "points" not in doc
+        # csv wire format with version/digest headers
+        with urllib.request.urlopen(f"{base}/skyline?format=csv") as r:
+            body = r.read().decode()
+            assert r.headers["X-Skyline-Version"] == "1"
+            assert r.headers["X-Skyline-Size"] == str(pts.shape[0])
+        assert len(body.splitlines()) == pts.shape[0]
+        assert body.splitlines()[0] == format_tuple_line(0, pts[0])
+        # bad params and unknown paths fail loudly, not silently
+        code, _, _ = _get(f"{base}/skyline?max_age_ms=bogus")
+        assert code == 400
+        code, _, _ = _get(f"{base}/deltas")
+        assert code == 400
+        code, _, _ = _get(f"{base}/nope")
+        assert code == 404
+        # delta catch-up, then 410 Gone once the ring rolls past
+        for _ in range(4):
+            store.publish(skyline_np(uniform(rng, 300, 2, 0, 10000)))
+        code, doc, _ = _get(f"{base}/deltas?since=4")
+        assert code == 200 and doc["to_version"] == 5
+        we, wl = _brute_delta(store.get(4).points, store.get(5).points)
+        assert _as_set(np.asarray(doc["entered"], np.float32).reshape(-1, 2)) == we
+        code, doc, _ = _get(f"{base}/deltas?since=1")
+        assert code == 410 and doc["oldest_since"] == 3
+    finally:
+        srv.close()
+
+
+def test_http_stale_read_rejected_unless_allowed(rng):
+    store = SnapshotStore()
+    srv = SkylineServer(store, bridge=QueryBridge(), port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        store.publish(rng.uniform(0, 1, size=(4, 2)))
+        store.note_ingest(watermark_id=7)  # snapshot now lags by 1
+        code, doc, _ = _get(f"{base}/skyline?max_version_lag=0")
+        assert code == 503 and doc["version_lag"] == 1
+        code, doc, _ = _get(
+            f"{base}/skyline?max_version_lag=0&allow_stale=1&refresh=1"
+        )
+        assert code == 200 and doc["stale"] and doc["refresh_triggered"]
+        # the refresh merge was queued for the worker loop to inject
+        assert srv.bridge.depth == 1
+        assert srv.admission.counters.get("stale_reads") == 2
+        assert srv.admission.counters.get("stale_rejected") == 1
+    finally:
+        srv.close()
+
+
+def test_http_read_shedding_emits_429_with_retry_after(rng):
+    store = SnapshotStore()
+    store.publish(rng.uniform(0, 1, size=(4, 2)))
+    srv = SkylineServer(
+        store,
+        admission=AdmissionController(read_rate=1.0, read_burst=2),
+        port=0,
+    )
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        codes, headers = [], []
+        for _ in range(6):
+            code, _, hdr = _get(f"{base}/skyline?points=0")
+            codes.append(code)
+            headers.append(hdr)
+        assert codes.count(200) == 2  # burst capacity
+        assert codes.count(429) == 4  # everything past it sheds explicitly
+        shed_hdr = headers[codes.index(429)]
+        assert int(shed_hdr["Retry-After"]) >= 1
+        st = srv.admission.stats()
+        assert st["reads_shed"] == 4 and st["reads_served"] == 2
+    finally:
+        srv.close()
+
+
+def test_http_query_gate_sheds_and_deadline_expires(rng):
+    # a bridge nobody drains: the first query rides to its deadline (503),
+    # a second concurrent one overflows the size-1/queue-0 gate (429)
+    store = SnapshotStore()
+    store.publish(rng.uniform(0, 1, size=(4, 2)))
+    srv = SkylineServer(
+        store,
+        bridge=QueryBridge(),
+        admission=AdmissionController(
+            max_concurrent_queries=1,
+            max_query_queue=0,
+            query_deadline_ms=600.0,
+        ),
+        port=0,
+    )
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        results = {}
+
+        def post(tag):
+            req = urllib.request.Request(
+                f"{base}/query", data=b"{}", method="POST"
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    results[tag] = (r.status, json.load(r))
+            except urllib.error.HTTPError as e:
+                results[tag] = (e.code, json.loads(e.read() or b"{}"))
+
+        t1 = threading.Thread(target=post, args=("first",))
+        t1.start()
+        time.sleep(0.2)  # first is in-flight, holding the gate
+        post("second")
+        t1.join(timeout=10)
+        assert results["second"][0] == 429
+        assert results["first"][0] == 503
+        assert "deadline" in results["first"][1]["error"]
+        st = srv.admission.stats()
+        assert st["queries_shed"] == 1 and st["queries_timed_out"] == 1
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# worker integration
+# --------------------------------------------------------------------------
+
+
+def _worker_with_serve(dims=2, serve_config=None):
+    bus = MemoryBus()
+    worker = SkylineWorker(
+        bus,
+        EngineConfig(
+            parallelism=2,
+            algo="mr-angle",
+            dims=dims,
+            domain_max=10000.0,
+            buffer_size=512,
+        ),
+        serve_port=0,
+        serve_config=serve_config,
+    )
+    return bus, worker
+
+
+def _ingest_window(bus, worker, x, id0, qid):
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(id0 + i, row) for i, row in enumerate(x)],
+    )
+    bus.produce("queries", format_trigger(qid, 0))
+    while worker.step() > 0:
+        pass
+
+
+def test_forced_query_is_reference_parity_and_publishes(rng):
+    bus, worker = _worker_with_serve(dims=3)
+    try:
+        port = worker.serve_server.port
+        x = anti_correlated(rng, 1500, 3, 0, 10000)
+        _ingest_window(bus, worker, x, 0, qid=0)
+        v1 = worker.serve_server.store.head_version
+        assert v1 >= 1
+        # more data arrives but no bus trigger: only POST /query can see it
+        y = anti_correlated(rng, 800, 3, 0, 10000)
+        bus.produce_many(
+            "input-tuples",
+            [format_tuple_line(1500 + i, r) for i, r in enumerate(y)],
+        )
+        while worker.step() > 0:
+            pass
+        out = {}
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/query", data=b"{}", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=20) as r:
+                out["doc"] = json.load(r)
+
+        t = threading.Thread(target=post)
+        t.start()
+        deadline = time.time() + 15
+        while t.is_alive() and time.time() < deadline:
+            worker.step()  # the worker loop drains the query bridge
+            time.sleep(0.005)
+        t.join(timeout=1)
+        expected = skyline_np(np.concatenate([x, y]))
+        assert out["doc"]["skyline_size"] == expected.shape[0]
+        # the forced merge also published a fresh snapshot for readers
+        store = worker.serve_server.store
+        assert store.head_version > v1
+        assert store.latest().size == expected.shape[0]
+        assert store.version_lag == 0
+        # serve results never leak onto the output topic: the only emission
+        # is the one bus-triggered window from the baseline ingest
+        assert bus.size(worker.output_topic) == 1
+        code, doc, _ = _get(f"http://127.0.0.1:{port}/skyline?max_version_lag=0")
+        assert code == 200 and doc["skyline_size"] == expected.shape[0]
+    finally:
+        worker.close()
+
+
+def test_concurrent_readers_during_active_ingest(rng):
+    """Acceptance: >=32 concurrent snapshot readers during active ingest —
+    every read inside its staleness bound, zero torn reads (digest-verified
+    payloads), versions monotone per reader — then shedding engages."""
+    bus, worker = _worker_with_serve(dims=2)
+    try:
+        port = worker.serve_server.port
+        store = worker.serve_server.store
+        # baseline snapshot so readers never race the first publish
+        _ingest_window(bus, worker, uniform(rng, 400, 2, 0, 10000), 0, qid=0)
+        assert store.head_version == 1
+
+        stop = threading.Event()
+        ingest_err = []
+
+        def ingest():
+            # the engine owner: keeps ingesting + publishing while readers
+            # hammer the HTTP plane from other threads
+            try:
+                nxt = 400
+                for qid in range(1, 40):
+                    if stop.is_set():
+                        return
+                    x = uniform(rng, 400, 2, 0, 10000)
+                    _ingest_window(bus, worker, x, nxt, qid=qid)
+                    nxt += 400
+            except Exception as e:  # pragma: no cover - diagnostic
+                ingest_err.append(e)
+
+        n_readers, reads_each = 32, 4
+        errors = []
+        url = (
+            f"http://127.0.0.1:{port}/skyline"
+            f"?max_age_ms=60000&max_version_lag=100000"
+        )
+
+        def reader(idx):
+            versions = []
+            try:
+                for _ in range(reads_each):
+                    code, doc, _ = _get(url, timeout=30)
+                    if code != 200:
+                        raise AssertionError(f"reader {idx}: HTTP {code} {doc}")
+                    if doc["stale"]:
+                        raise AssertionError(f"reader {idx}: stale served")
+                    pts = np.asarray(doc["points"], np.float32).reshape(
+                        -1, 2
+                    )
+                    if points_digest(pts) != doc["digest"]:
+                        raise AssertionError(f"reader {idx}: torn read")
+                    versions.append(doc["version"])
+                if versions != sorted(versions):
+                    raise AssertionError(
+                        f"reader {idx}: versions regressed {versions}"
+                    )
+            except Exception as e:
+                errors.append(e)
+
+        it = threading.Thread(target=ingest)
+        it.start()
+        readers = [
+            threading.Thread(target=reader, args=(i,))
+            for i in range(n_readers)
+        ]
+        for t in readers:
+            t.start()
+        for t in readers:
+            t.join(timeout=120)
+        stop.set()
+        it.join(timeout=120)
+        assert not ingest_err, ingest_err
+        assert not errors, errors[:3]
+        assert store.head_version > 1  # ingest really ran under the readers
+        served = worker.serve_server.admission.counters.get("reads_served")
+        assert served == n_readers * reads_each
+
+        # shed phase: same store behind a deliberately tight token bucket
+        shed_srv = SkylineServer(
+            store,
+            admission=AdmissionController(read_rate=20.0, read_burst=4),
+            port=0,
+        )
+        try:
+            codes = []
+            lock = threading.Lock()
+
+            def hammer():
+                for _ in range(8):
+                    code, _, _ = _get(
+                        f"http://127.0.0.1:{shed_srv.port}/skyline?points=0"
+                    )
+                    with lock:
+                        codes.append(code)
+
+            hs = [threading.Thread(target=hammer) for _ in range(8)]
+            for t in hs:
+                t.start()
+            for t in hs:
+                t.join(timeout=60)
+            assert codes.count(429) > 0  # shedding engaged
+            assert codes.count(200) >= 4  # but the burst was served
+            assert shed_srv.admission.counters.get("reads_shed") == codes.count(
+                429
+            )
+        finally:
+            shed_srv.close()
+    finally:
+        worker.close()
+
+
+def test_sliding_engine_publishes_versioned_snapshots(rng):
+    from skyline_tpu.stream.sliding_engine import SlidingEngine
+
+    cfg = EngineConfig(
+        parallelism=2, algo="mr-angle", dims=2, domain_max=1000.0
+    )
+    eng = SlidingEngine(cfg, window_size=400, slide=200)
+    store = SnapshotStore()
+    eng.attach_snapshots(store)
+    x = rng.uniform(0, 1000, size=(900, 2)).astype(np.float32)
+    eng.process_records(np.arange(900, dtype=np.int64), x)
+    assert store.version_lag == 1  # ingest noted, nothing published yet
+    eng.process_trigger("0,0")
+    eng.poll_results()
+    snap = store.latest()
+    assert snap is not None and snap.version == 1
+    assert store.version_lag == 0 and snap.watermark_id == 899
+    # sliding-specific provenance rides in the snapshot meta
+    assert snap.meta["window_filled"] and snap.meta["slides_closed"] >= 2
+    # the snapshot is the sliding window's skyline, not the full stream's
+    lo = 900 - (900 - 400) % 200 - 400  # oldest row still inside the window
+    assert _as_set(snap.points) == _as_set(skyline_np(x[lo:]))
+
+
+def test_serve_cli_flags_reach_serve_config():
+    from skyline_tpu.utils.config import parse_job_args
+
+    cfg = parse_job_args(
+        [
+            "--serve", "0",
+            "--serve-read-rate", "123.5",
+            "--serve-read-burst", "9",
+            "--serve-max-queries", "3",
+            "--serve-query-queue", "5",
+            "--serve-query-deadline-ms", "2500",
+            "--serve-delta-ring", "33",
+            "--serve-history", "17",
+        ]
+    )
+    assert cfg.serve_port == 0
+    sc = cfg.serve_config()
+    assert isinstance(sc, ServeConfig)
+    assert sc.read_rate == 123.5 and sc.read_burst == 9
+    assert sc.max_concurrent_queries == 3 and sc.max_query_queue == 5
+    assert sc.query_deadline_ms == 2500 and sc.delta_ring == 33
+    assert sc.history == 17
+    # off by default: no serving plane unless asked for
+    assert parse_job_args([]).serve_port == -1
+
+
+def test_worker_stats_include_serve_sections(rng):
+    bus, worker = _worker_with_serve(dims=2)
+    try:
+        _ingest_window(bus, worker, uniform(rng, 300, 2, 0, 10000), 0, qid=0)
+        code, doc, _ = _get(
+            f"http://127.0.0.1:{worker.serve_server.port}/stats"
+        )
+        assert code == 200
+        assert doc["snapshot_store"]["head_version"] == 1
+        assert doc["delta_ring"]["head_version"] == 1
+        assert doc["records_in"] == 300  # worker counters ride along
+        assert doc["serve"]["bridge_depth"] == 0
+    finally:
+        worker.close()
