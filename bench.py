@@ -119,6 +119,7 @@ def serve_leg(d: int, algo: str) -> dict:
         SnapshotStore,
     )
     from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.telemetry import Histogram
     from skyline_tpu.workload.generators import anti_correlated
 
     n = int(os.environ.get("BENCH_SERVE_N", 65536))
@@ -139,7 +140,7 @@ def serve_leg(d: int, algo: str) -> dict:
     eng.poll_results()
     snap = store.latest()
 
-    def hammer(server, total, threads, lats, codes):
+    def hammer(server, total, threads, hist, codes):
         url = (
             f"http://127.0.0.1:{server.port}/skyline"
             f"?points={points}&max_age_ms=600000"
@@ -155,7 +156,8 @@ def serve_leg(d: int, algo: str) -> dict:
                         codes.append(r.status)
                 except urllib.error.HTTPError as e:
                     codes.append(e.code)
-                lats.append((time.perf_counter() - t0) * 1000.0)
+                if hist is not None:
+                    hist.observe((time.perf_counter() - t0) * 1000.0)
 
         ts = [threading.Thread(target=reader) for _ in range(threads)]
         for t in ts:
@@ -163,12 +165,14 @@ def serve_leg(d: int, algo: str) -> dict:
         for t in ts:
             t.join()
 
-    # (a) latency under concurrency, no admission limit
-    lats: list[float] = []
+    # (a) latency under concurrency, no admission limit — reader threads
+    # observe straight into the shared telemetry Histogram (thread-safe),
+    # the same summary machinery the worker's /stats p50/p99 tiles use
+    read_hist = Histogram("serve_read_ms")
     codes: list[int] = []
     srv = SkylineServer(store, admission=AdmissionController(), port=0)
     t0 = time.perf_counter()
-    hammer(srv, readers * reads_each, readers, lats, codes)
+    hammer(srv, readers * reads_each, readers, read_hist, codes)
     wall_s = time.perf_counter() - t0
     srv.close()
     # (b) shed behavior against a deliberately tight token bucket
@@ -178,14 +182,15 @@ def serve_leg(d: int, algo: str) -> dict:
         admission=AdmissionController(read_rate=500.0, read_burst=64),
         port=0,
     )
-    hammer(srv, readers * reads_each, readers, [], shed_codes)
+    hammer(srv, readers * reads_each, readers, None, shed_codes)
     srv.close()
     shed = sum(1 for c in shed_codes if c == 429)
+    read_pcts = read_hist.percentiles(50, 99)
     return {
-        "read_p50_ms": round(float(np.percentile(lats, 50)), 2),
-        "read_p99_ms": round(float(np.percentile(lats, 99)), 2),
+        "read_p50_ms": round(read_pcts["p50"], 2),
+        "read_p99_ms": round(read_pcts["p99"], 2),
         "reads_ok": sum(1 for c in codes if c == 200),
-        "reads_per_sec": round(len(lats) / wall_s, 1),
+        "reads_per_sec": round(read_hist.count / wall_s, 1),
         "readers": readers,
         "reads_per_reader": reads_each,
         "payload_points": points == "1",
@@ -285,15 +290,19 @@ def child_main(backend: str) -> None:
     }
     phases["profile_window_total"] = round(prof_dt * 1000.0, 1)
 
-    lats = []
+    # the telemetry Histogram keeps small samples verbatim, so this p50 is
+    # the exact median of the measured windows (same machinery as /stats)
+    from skyline_tpu.telemetry import Histogram
+
+    lat_hist = Histogram("window_latency_s", unit="s")
     sky_sizes = []
     for _ in range(windows):
         x = anti_correlated(rng, n, d, 0, 10000)
         dt, res = run_window(cfg, ids, x, required)
-        lats.append(dt)
+        lat_hist.observe(dt)
         sky_sizes.append(res["skyline_size"])
 
-    p50_s = float(np.percentile(lats, 50))
+    p50_s = lat_hist.quantile(0.5)
     tuples_per_sec = n / p50_s
     real_backend = jax.default_backend()
     # serving-plane leg: read-side latency + shed behavior (BENCH_SERVE=0

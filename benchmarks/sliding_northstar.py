@@ -66,7 +66,11 @@ def main(argv=None):
     eng = SlidingEngine(cfg, window_size=a.window, slide=slide)
     rng = np.random.default_rng(0)
     next_id = 0
-    lats: list[float] = []
+    # shared telemetry Histogram: exact order-statistic quantiles at this
+    # sample count, same percentile machinery as bench.py and /stats
+    from skyline_tpu.telemetry import Histogram
+
+    lat_hist = Histogram("slide_latency_s", unit="s")
     sky_sizes: list[int] = []
     warm = a.k  # slides that fill the window (not measured)
     for s in range(a.k + a.slides):
@@ -79,7 +83,7 @@ def main(argv=None):
         (res,) = eng.poll_results()
         dt = time.perf_counter() - t0
         if s >= warm:
-            lats.append(dt)
+            lat_hist.observe(dt)
             sky_sizes.append(res["skyline_size"])
         print(
             json.dumps(
@@ -93,8 +97,8 @@ def main(argv=None):
             ),
             flush=True,
         )
-    p50 = float(np.percentile(lats, 50))
-    p90 = float(np.percentile(lats, 90))
+    p50 = lat_hist.quantile(0.5)
+    p90 = lat_hist.quantile(0.9)
     out = {
         "config": (
             f"sliding_{a.dims}d_anticorrelated_w{a.window}_s{slide}"
@@ -104,7 +108,7 @@ def main(argv=None):
         "slide": slide,
         "dims": a.dims,
         "algo": a.algo,
-        "slides_measured": len(lats),
+        "slides_measured": lat_hist.count,
         "per_slide_p50_s": round(p50, 3),
         "per_slide_p90_s": round(p90, 3),
         "sustained_slides_per_s": round(1.0 / p50, 3),

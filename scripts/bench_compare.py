@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff the two newest ``BENCH_r*.json`` artifacts.
+
+Each round's measurement script records ``BENCH_r<NN>.json`` with a
+``parsed`` block (the bench.py JSON line). This gate compares the newest
+two — or two explicitly given paths — on the headline metrics and exits
+non-zero when any regresses past ``--threshold`` (default 25%):
+
+  value                  tuples/s          lower is a regression
+  p50_window_latency_ms  end-to-end p50    higher is a regression
+  serve.read_p50_ms      serve read p50    higher is a regression
+  serve.read_p99_ms      serve read p99    higher is a regression
+
+A metric missing from either artifact (e.g. the serve leg was skipped) is
+reported as ``skipped`` and never fails the gate. Runs on different
+backends (``tpu`` vs ``cpu-fallback``) are incomparable: the gate prints
+why and exits 0 — a TPU outage must not read as a perf regression.
+
+Usage:
+  python scripts/bench_compare.py                      # newest two in CWD
+  python scripts/bench_compare.py OLD.json NEW.json    # explicit pair
+  python scripts/bench_compare.py --threshold 0.10     # tighter gate
+  python scripts/bench_compare.py --dir /path/to/repo  # artifact directory
+
+Exit codes: 0 ok (or incomparable/skipped), 1 regression, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# (label, path into parsed, higher_is_better)
+METRICS = (
+    ("value", ("value",), True),
+    ("p50_window_latency_ms", ("p50_window_latency_ms",), False),
+    ("serve.read_p50_ms", ("serve", "read_p50_ms"), False),
+    ("serve.read_p99_ms", ("serve", "read_p99_ms"), False),
+)
+
+
+def load_parsed(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict):
+        raise ValueError(f"{path}: no 'parsed' block (bench run failed?)")
+    return parsed
+
+
+def dig(parsed: dict, path: tuple) -> float | None:
+    cur = parsed
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+        return float(cur)
+    return None
+
+
+def compare(old: dict, new: dict, threshold: float) -> tuple[list[str], bool]:
+    """Return (report lines, any_regression)."""
+    lines = []
+    regressed = False
+    for label, path, higher_better in METRICS:
+        a, b = dig(old, path), dig(new, path)
+        if a is None or b is None or a == 0:
+            lines.append(f"  {label:<24} skipped (absent or zero)")
+            continue
+        delta = (b - a) / a
+        bad = (-delta if higher_better else delta) > threshold
+        arrow = "REGRESSION" if bad else "ok"
+        lines.append(
+            f"  {label:<24} {a:>12.2f} -> {b:>12.2f}  "
+            f"({delta:+.1%})  {arrow}"
+        )
+        regressed = regressed or bad
+    return lines, regressed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="explicit OLD NEW artifact paths (default: the "
+                         "two newest BENCH_r*.json in --dir)")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="max tolerated fractional regression per metric "
+                         "(default 0.25 = 25%%)")
+    ap.add_argument("--dir", default=".",
+                    help="directory scanned for BENCH_r*.json")
+    a = ap.parse_args(argv)
+    if a.threshold <= 0:
+        print("bench_compare: --threshold must be > 0", file=sys.stderr)
+        return 2
+
+    if a.paths:
+        if len(a.paths) != 2:
+            print("bench_compare: give exactly OLD and NEW paths",
+                  file=sys.stderr)
+            return 2
+        old_path, new_path = a.paths
+    else:
+        found = sorted(glob.glob(os.path.join(a.dir, "BENCH_r*.json")))
+        if len(found) < 2:
+            print(
+                f"bench_compare: fewer than two BENCH_r*.json in {a.dir!r}; "
+                "nothing to compare", file=sys.stderr,
+            )
+            return 0
+        old_path, new_path = found[-2], found[-1]
+
+    try:
+        old, new = load_parsed(old_path), load_parsed(new_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+
+    ob, nb = old.get("backend"), new.get("backend")
+    print(f"bench_compare: {old_path} ({ob}) -> {new_path} ({nb})")
+    if ob != nb:
+        print(
+            f"  backends differ ({ob} vs {nb}): incomparable, gate passes "
+            "(a TPU outage is not a perf regression)"
+        )
+        return 0
+
+    lines, regressed = compare(old, new, a.threshold)
+    print("\n".join(lines))
+    if regressed:
+        print(
+            f"bench_compare: REGRESSION beyond {a.threshold:.0%} threshold",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"bench_compare: ok (threshold {a.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

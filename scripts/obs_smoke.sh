@@ -1,0 +1,155 @@
+#!/usr/bin/env bash
+# Smoke the observability plane end-to-end on one host, no broker, no TPU:
+# a SkylineWorker over the in-memory bus with BOTH HTTP surfaces up
+# (--stats-port 0 and --serve 0) plus --trace-out, then assert
+#   * GET /metrics on the stats server AND the serve server parses as
+#     Prometheus text exposition (minimal inline parser),
+#   * GET /trace is Chrome trace-event JSON carrying the ingest -> local
+#     -> merge -> publish spans of the query just answered,
+#   * /stats carries latency_ms histogram summaries (p50/p99 tiles),
+#   * the --trace-out file written on close() validates the same way,
+# and finally exercise the bench regression gate both directions
+# (ok -> rc 0, forced regression -> rc 1).
+#
+#   scripts/obs_smoke.sh
+#
+# Exits non-zero on any failed assertion. CPU-only (JAX_PLATFORMS=cpu).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE_OUT="$(mktemp -d)/obs_smoke_trace.json"
+export TRACE_OUT
+
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+from skyline_tpu.bridge import MemoryBus, SkylineWorker
+from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+from skyline_tpu.utils.config import parse_job_args
+from skyline_tpu.workload.generators import anti_correlated
+
+trace_out = os.environ["TRACE_OUT"]
+
+
+def parse_prom(text):
+    """Minimal Prometheus text parser: {name: [(labels, value), ...]}."""
+    series = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        assert head and val, f"malformed sample line: {line!r}"
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            assert rest.endswith("}"), f"malformed labels: {line!r}"
+        else:
+            name = head
+        float(val)  # must parse
+        series.setdefault(name, []).append(val)
+    assert series, "no samples in exposition"
+    return series
+
+
+cfg = parse_job_args(
+    ["--serve", "0", "--stats-port", "0", "--parallelism", "2",
+     "--dims", "3", "--trace-out", trace_out]
+)
+bus = MemoryBus()
+worker = SkylineWorker(
+    bus,
+    cfg.engine_config(),
+    stats_port=cfg.stats_port,
+    serve_port=cfg.serve_port,
+    serve_config=cfg.serve_config(),
+    trace_out=cfg.trace_out,
+)
+try:
+    rng = np.random.default_rng(7)
+    x = anti_correlated(rng, 3000, 3, 0, 10000)
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, row) for i, row in enumerate(x)],
+    )
+    bus.produce("queries", format_trigger(0, 0))
+    while worker.step() > 0:
+        pass
+
+    stats_base = f"http://127.0.0.1:{worker.stats_server.port}"
+    serve_base = f"http://127.0.0.1:{worker.serve_server.port}"
+
+    # serve a read so serve_read_ms has a sample too
+    with urllib.request.urlopen(f"{serve_base}/skyline", timeout=5) as r:
+        assert json.load(r)["version"] == 1
+
+    for label, base in (("stats", stats_base), ("serve", serve_base)):
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            ctype = r.headers.get("Content-Type", "")
+            series = parse_prom(r.read().decode())
+        assert "version=0.0.4" in ctype, ctype
+        assert any(k.startswith("skyline_") for k in series), sorted(series)
+        print(f"[obs-smoke] {label} /metrics ok: {len(series)} series")
+    with urllib.request.urlopen(f"{stats_base}/metrics", timeout=5) as r:
+        body = r.read().decode()
+    for want in ("skyline_ingest_batch_ms_bucket",
+                 "skyline_query_latency_ms_count"):
+        assert want in body, f"{want} missing from exposition"
+
+    with urllib.request.urlopen(f"{stats_base}/stats", timeout=5) as r:
+        stats = json.load(r)
+    lat = stats["latency_ms"]
+    assert lat["query_latency_ms"]["count"] >= 1, lat
+    assert "p99" in lat["query_latency_ms"], lat
+    print(f"[obs-smoke] /stats latency tiles ok: "
+          f"{[k for k, v in lat.items() if v['count'] > 0]}")
+
+    with urllib.request.urlopen(f"{stats_base}/trace", timeout=5) as r:
+        doc = json.load(r)
+    names = {e["name"] for e in doc["traceEvents"]}
+    for want in ("ingest", "local", "merge", "publish", "query"):
+        assert want in names, (want, names)
+    for e in doc["traceEvents"]:
+        assert e["ph"] == "X" and "ts" in e and "dur" in e, e
+    print(f"[obs-smoke] /trace ok: {len(doc['traceEvents'])} events")
+finally:
+    worker.close()
+
+# close() wrote the span ring as a Chrome trace file
+with open(trace_out) as f:
+    doc = json.load(f)
+names = {e["name"] for e in doc["traceEvents"]}
+for want in ("ingest", "local", "merge", "publish"):
+    assert want in names, (want, names)
+print(f"[obs-smoke] --trace-out ok: {len(doc['traceEvents'])} events "
+      f"at {trace_out} (load at https://ui.perfetto.dev)")
+print("[obs-smoke] PASS")
+EOF
+
+# regression gate: newest two artifacts must currently pass at default
+# threshold, and an artificially regressed NEW must fail with rc 1
+python scripts/bench_compare.py
+REGRESSED="$(mktemp -d)"
+JAX_PLATFORMS=cpu python - "$REGRESSED" <<'EOF'
+import glob, json, os, sys
+dst = sys.argv[1]
+found = sorted(glob.glob("BENCH_r*.json"))[-2:]
+assert len(found) == 2, "need two BENCH_r*.json artifacts"
+old, new = found
+for src, name in ((old, "BENCH_r01.json"), (new, "BENCH_r02.json")):
+    with open(src) as f:
+        doc = json.load(f)
+    if name == "BENCH_r02.json":
+        doc["parsed"]["value"] *= 0.5  # force a 50% throughput regression
+    with open(os.path.join(dst, name), "w") as f:
+        json.dump(doc, f)
+EOF
+if python scripts/bench_compare.py --dir "$REGRESSED"; then
+  echo "[obs-smoke] FAIL: bench_compare missed a forced 50% regression" >&2
+  exit 1
+fi
+echo "[obs-smoke] bench_compare gate ok (pass + forced-regression trip)"
+echo "[obs-smoke] ALL PASS"

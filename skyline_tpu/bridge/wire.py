@@ -130,7 +130,9 @@ def format_result(result: dict) -> str:
     if "optimality" in out:
         out["optimality"] = float(f"{out['optimality']:.4f}")
     # extension fields beyond the reference schema (partial-result marker,
-    # missing_partitions, skyline_points) ride along after the known fields
+    # missing_partitions, skyline_points, and trace_id — the telemetry
+    # span-correlation key minted at trigger ingestion) ride along after
+    # the known fields, so reference-parity consumers are untouched
     for k, v in result.items():
         if k not in out:
             out[k] = v

@@ -38,6 +38,10 @@ class SkylineWorker:
         tracer=None,
         serve_port: int | None = None,
         serve_config=None,
+        telemetry=None,
+        trace_ring: int = 4096,
+        trace_out: str | None = None,
+        jax_profile_dir: str | None = None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``stats_port``: serve
@@ -58,12 +62,29 @@ class SkylineWorker:
         ``tracer``: optional ``metrics.tracing.Tracer``; by default the
         worker traces its own loop (transport poll / parse / engine phases)
         with ``sync_device=False`` so the breakdown is observable in
-        ``/stats`` without perturbing the async device pipeline."""
+        ``/stats`` without perturbing the async device pipeline.
+        ``telemetry``: optional shared ``telemetry.Telemetry`` hub; the
+        worker always has one (created here when not given, span ring sized
+        ``trace_ring``) and threads it through the engine and both HTTP
+        servers — latency histograms + per-query spans cost one lock each.
+        ``trace_out``: write the span ring as Chrome trace-event JSON to
+        this path on ``close()`` (load at https://ui.perfetto.dev).
+        ``jax_profile_dir``: opt-in — wrap each forced-query injection
+        (POST /query) in ``jax.profiler.trace`` writing to this directory,
+        so a device-level profile of exactly one consistency merge can be
+        captured from a live worker."""
         from skyline_tpu.metrics.tracing import Tracer
+        from skyline_tpu.telemetry import Telemetry
 
         self.bus = bus
         self.max_drain_polls = max_drain_polls
         self.tracer = tracer if tracer is not None else Tracer(sync_device=False)
+        self.telemetry = (
+            telemetry if telemetry is not None
+            else Telemetry(span_capacity=trace_ring)
+        )
+        self.trace_out = trace_out
+        self._jax_profile_dir = jax_profile_dir
         self._phase_snapshot_ms: dict[str, float] = {}
         self._last_phase_report_s = 0.0
         # None = undecided, True = zero-copy array plane, False = line plane
@@ -81,9 +102,12 @@ class SkylineWorker:
                 mesh=mesh,
                 emit_per_slide=emit_per_slide,
                 tracer=self.tracer,
+                telemetry=self.telemetry,
             )
         else:
-            self.engine = SkylineEngine(config, mesh=mesh, tracer=self.tracer)
+            self.engine = SkylineEngine(
+                config, mesh=mesh, tracer=self.tracer, telemetry=self.telemetry
+            )
         self.output_topic = output_topic
         self._data = bus.consumer(input_topic, from_beginning=True)
         self._queries = bus.consumer(query_topic, from_beginning=False)
@@ -113,6 +137,7 @@ class SkylineWorker:
                     bridge=self._serve_bridge,
                     port=serve_port,
                     host=scfg.host,
+                    telemetry=self.telemetry,
                 )
             except OSError as e:
                 # like /stats: the serving plane is optional — a port
@@ -129,7 +154,9 @@ class SkylineWorker:
             from skyline_tpu.metrics.httpstats import StatsServer
 
             try:
-                self.stats_server = StatsServer(self.stats, stats_port)
+                self.stats_server = StatsServer(
+                    self.stats, stats_port, telemetry=self.telemetry
+                )
             except OSError as e:
                 # observability is optional: a port conflict must not take
                 # the worker (and with it the whole deploy stack) down
@@ -146,12 +173,31 @@ class SkylineWorker:
         out["phase_breakdown_ms"] = {
             k: round(v["total_ms"], 1) for k, v in self.tracer.report().items()
         }
+        # latency distributions (ingest batch / merge / query latency /
+        # serve reads): p50/p90/p99 summaries, the dashboard's tiles
+        out["latency_ms"] = self.telemetry.latency_snapshot()
         if self.serve_server is not None:
             out["serve"] = self.serve_server.admission.stats()
             out["snapshot_store"] = self.serve_server.store.stats()
         return out
 
     def close(self) -> None:
+        if getattr(self, "_closed", False):
+            return  # idempotent: callers and teardown paths may both close
+        self._closed = True
+        if self.trace_out:
+            try:
+                n = self.telemetry.spans.write_chrome(self.trace_out)
+                print(
+                    f"skyline worker: wrote {n} trace span(s) to "
+                    f"{self.trace_out}",
+                    file=sys.stderr,
+                )
+            except OSError as e:
+                print(
+                    f"skyline worker: --trace-out {self.trace_out} failed: {e}",
+                    file=sys.stderr,
+                )
         if self.stats_server is not None:
             self.stats_server.close()
         if self.serve_server is not None:
@@ -281,7 +327,7 @@ class SkylineWorker:
             if self._serve_bridge is not None:
                 # forced consistency merges from POST /query run on this
                 # thread, after bus triggers — the engine stays single-owner
-                self._serve_bridge.inject(self.engine)
+                self._inject_serve_queries()
             self.engine.check_timeouts()
         results = self.engine.poll_results()
         if self._serve_bridge is not None:
@@ -292,6 +338,26 @@ class SkylineWorker:
             self.results_emitted += 1
             self._report_phases()
         return total_lines + len(triggers)
+
+    def _inject_serve_queries(self) -> None:
+        """Run the serve-plane's queued forced merges; with
+        ``jax_profile_dir`` set, wrap the injection in ``jax.profiler.trace``
+        so exactly one POST /query's device work lands in a profile."""
+        if self._jax_profile_dir and self._serve_bridge.pending_injections:
+            try:
+                import jax
+
+                with jax.profiler.trace(self._jax_profile_dir):
+                    self._serve_bridge.inject(self.engine)
+                return
+            except Exception as e:  # profiling is opt-in observability:
+                # never let a profiler failure shed the query itself
+                print(
+                    f"skyline worker: jax.profiler.trace failed ({e}); "
+                    "running injection unprofiled",
+                    file=sys.stderr,
+                )
+        self._serve_bridge.inject(self.engine)
 
     def _report_phases(self) -> None:
         """Per-result stderr breakdown: the DELTA of each phase since the
@@ -360,6 +426,9 @@ def main(argv=None):
         max_drain_polls=cfg.max_drain_polls,
         serve_port=cfg.serve_port if cfg.serve_port >= 0 else None,
         serve_config=cfg.serve_config() if cfg.serve_port >= 0 else None,
+        trace_ring=cfg.trace_ring,
+        trace_out=cfg.trace_out or None,
+        jax_profile_dir=cfg.jax_profile_dir or None,
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "

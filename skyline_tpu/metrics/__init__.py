@@ -1,5 +1,17 @@
-"""Metrics collection (result JSON → CSV) and phase tracing."""
+"""Reference-parity metrics: result-JSON → CSV collector and phase tracing.
 
-from skyline_tpu.metrics.collector import CSV_HEADERS, append_result_row, collect
+The distribution/trace side of observability (histograms, per-query spans,
+Prometheus exposition) lives in ``skyline_tpu.telemetry``, which absorbs
+and extends this package; what stays here is the reference-parity surface:
+the CSV collector (10-column schema), ``Counters``, the phase-total
+``Tracer``, and the /stats HTTP server (``httpstats``).
+"""
 
-__all__ = ["CSV_HEADERS", "append_result_row", "collect"]
+from skyline_tpu.metrics.collector import (
+    CSV_HEADERS,
+    Counters,
+    append_result_row,
+    collect,
+)
+
+__all__ = ["CSV_HEADERS", "Counters", "append_result_row", "collect"]

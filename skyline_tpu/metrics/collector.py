@@ -57,9 +57,14 @@ CSV_HEADERS = [
     "SkylinePoints",
 ]
 
+# guards the isfile-check-then-write in append_result_row: two concurrent
+# writers (collector CLI + an embedded worker, or two worker threads) could
+# both see "no file" and both write the header
+_append_lock = threading.Lock()
+
 
 def result_to_row(data: dict) -> list:
-    return [
+    row = [
         data.get("query_id", "N/A"),
         data.get("record_count", 0),
         data.get("skyline_size", 0),
@@ -71,17 +76,29 @@ def result_to_row(data: dict) -> list:
         data.get("query_latency_ms", 0),
         json.dumps(data.get("skyline_points", [])),
     ]
+    # trace_id (telemetry plane) rides as a trailing column ONLY when the
+    # result carries one, so reference-parity consumers of the 10-column
+    # schema see byte-identical output for untraced streams
+    if "trace_id" in data:
+        row.append(data["trace_id"])
+    return row
 
 
 def append_result_row(path: str, data: dict) -> None:
     """Append one result to a CSV file, writing the header on first touch."""
-    exists = os.path.isfile(path)
-    with open(path, mode="a", newline="") as f:
-        w = csv.writer(f)
-        if not exists:
-            w.writerow(CSV_HEADERS)
-        w.writerow(result_to_row(data))
-        f.flush()
+    with _append_lock:
+        exists = os.path.isfile(path)
+        with open(path, mode="a", newline="") as f:
+            w = csv.writer(f)
+            if not exists:
+                headers = (
+                    CSV_HEADERS + ["TraceID"]
+                    if "trace_id" in data
+                    else CSV_HEADERS
+                )
+                w.writerow(headers)
+            w.writerow(result_to_row(data))
+            f.flush()
 
 
 def collect(messages, path: str, echo: bool = True) -> int:

@@ -10,9 +10,15 @@ during a ``deploy/launch.py`` run.
 
 Endpoints:
   GET /         human dashboard (single self-contained HTML page polling
-                /stats — headline counters + per-partition load bars; the
-                Flink-Web-UI role for an operator's browser)
-  GET /stats    full stats JSON (engine counters, partitions, worker I/O)
+                /stats — headline counters, serve-plane counters, p50/p99
+                latency tiles + per-partition load bars; the Flink-Web-UI
+                role for an operator's browser)
+  GET /stats    full stats JSON (engine counters, partitions, worker I/O,
+                serve counters, latency histogram summaries)
+  GET /metrics  Prometheus text exposition (stats flattened to gauges +
+                telemetry counters/histograms), for a standard scraper
+  GET /trace    Chrome trace-event JSON of the telemetry span ring
+                (load at https://ui.perfetto.dev)
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -26,10 +32,13 @@ _DASHBOARD = """<!doctype html>
 <html><head><meta charset="utf-8"><title>tpu-skyline worker</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:2rem;background:#14171c;color:#e6e6e6}
- h1{font-size:1.2rem;font-weight:600} .muted{color:#8a93a3}
- .tiles{display:flex;gap:1rem;flex-wrap:wrap;margin:1rem 0}
+ h1{font-size:1.2rem;font-weight:600} h2{font-size:.8rem;color:#8a93a3;
+ text-transform:uppercase;letter-spacing:.05em;margin:1.2rem 0 .3rem}
+ .muted{color:#8a93a3}
+ .tiles{display:flex;gap:1rem;flex-wrap:wrap;margin:.4rem 0}
  .tile{background:#1e232b;border-radius:8px;padding:.8rem 1.1rem;min-width:9rem}
  .tile .v{font-size:1.5rem;font-variant-numeric:tabular-nums}
+ .tile .s{font-size:.85rem;color:#b9c2d0;font-variant-numeric:tabular-nums}
  .tile .k{font-size:.75rem;color:#8a93a3;text-transform:uppercase;letter-spacing:.05em}
  table{border-collapse:collapse;margin-top:.6rem;font-variant-numeric:tabular-nums}
  td,th{padding:.25rem .7rem;text-align:right;font-size:.85rem}
@@ -39,6 +48,10 @@ _DASHBOARD = """<!doctype html>
 </style></head><body>
 <h1>tpu-skyline worker <span class="muted" id="ts"></span></h1>
 <div class="tiles" id="tiles"></div>
+<div id="serveblock" style="display:none"><h2>serving plane</h2>
+<div class="tiles" id="servetiles"></div></div>
+<div id="latblock" style="display:none"><h2>latency (p50 / p99 ms)</h2>
+<div class="tiles" id="lattiles"></div></div>
 <table id="parts"></table>
 <div id="err"></div>
 <script>
@@ -62,6 +75,30 @@ async function tick() {
     document.getElementById("tiles").innerHTML = tiles.map(
       ([k, v]) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`
     ).join("");
+    const sv = s.serve, st = s.snapshot_store;
+    const serveTiles = sv === undefined ? [] : [
+      ["reads served", sv.reads_served || 0],
+      ["reads shed (429)", sv.reads_shed || 0],
+      ["stale rejected (503)", sv.stale_rejected || 0],
+      ["delta re-baselines (410)", sv.deltas_gone || 0],
+      ["queries shed (429)", sv.queries_shed || 0],
+      ["snapshot version", st && st.head_version],
+      ["version lag", st && st.version_lag],
+    ].filter(([, v]) => v !== undefined);
+    document.getElementById("serveblock").style.display =
+      serveTiles.length ? "" : "none";
+    document.getElementById("servetiles").innerHTML = serveTiles.map(
+      ([k, v]) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`
+    ).join("");
+    const lat = s.latency_ms || {};
+    const latTiles = Object.entries(lat).filter(([, h]) => h.count > 0).map(
+      ([name, h]) =>
+        `<div class="tile"><div class="s">${fmt(h.p50)} / ${fmt(h.p99)}</div>` +
+        `<div class="k">${name} (n=${fmt(h.count)})</div></div>`
+    );
+    document.getElementById("latblock").style.display =
+      latTiles.length ? "" : "none";
+    document.getElementById("lattiles").innerHTML = latTiles.join("");
     const p = s.partitions || {};
     const seen = p.records_seen || [], ids = p.max_seen_id || [],
           sky = p.skyline_counts;
@@ -80,15 +117,20 @@ tick(); setInterval(tick, 1000);
 
 
 class StatsServer:
-    """Background stats server: JSON (/stats, /healthz) + dashboard (/).
+    """Background stats server: JSON (/stats, /healthz), Prometheus
+    (/metrics), Chrome trace JSON (/trace) + dashboard (/).
 
-    ``callback`` is invoked per /stats request and must return a
-    JSON-serializable dict; exceptions become a 500 with the error message
-    (the server never takes the worker down).
+    ``callback`` is invoked per /stats (and /metrics) request and must
+    return a JSON-serializable dict; exceptions become a 500 with the error
+    message (the server never takes the worker down). ``telemetry`` is an
+    optional ``telemetry.Telemetry`` hub — its counters and histograms join
+    the exposition and its span ring backs /trace.
     """
 
-    def __init__(self, callback, port: int, host: str = "127.0.0.1"):
+    def __init__(self, callback, port: int, host: str = "127.0.0.1", telemetry=None):
         self._callback = callback
+        self.telemetry = telemetry
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(handler):  # noqa: N805 — http.server API
@@ -97,24 +139,36 @@ class StatsServer:
                 elif handler.path == "/stats":
                     try:
                         handler._reply(200, callback())
-                    except Exception as e:  # pragma: no cover - defensive
+                    except Exception as e:
                         handler._reply(500, {"error": str(e)})
-                elif handler.path in ("/", "/ui"):
-                    body = _DASHBOARD.encode()
-                    handler.send_response(200)
-                    handler.send_header(
-                        "Content-Type", "text/html; charset=utf-8"
+                elif handler.path == "/metrics":
+                    try:
+                        body, ctype = outer._render_metrics()
+                        handler._reply_raw(200, body, ctype)
+                    except Exception as e:
+                        handler._reply(500, {"error": str(e)})
+                elif handler.path == "/trace":
+                    doc = (
+                        outer.telemetry.spans.to_chrome()
+                        if outer.telemetry is not None
+                        else {"traceEvents": []}
                     )
-                    handler.send_header("Content-Length", str(len(body)))
-                    handler.end_headers()
-                    handler.wfile.write(body)
+                    handler._reply(200, doc)
+                elif handler.path in ("/", "/ui"):
+                    handler._reply_raw(
+                        200, _DASHBOARD.encode(), "text/html; charset=utf-8"
+                    )
                 else:
                     handler._reply(404, {"error": "not found"})
 
             def _reply(handler, code: int, doc: dict):
-                body = json.dumps(doc).encode()
+                handler._reply_raw(
+                    code, json.dumps(doc).encode(), "application/json"
+                )
+
+            def _reply_raw(handler, code: int, body: bytes, ctype: str):
                 handler.send_response(code)
-                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Type", ctype)
                 handler.send_header("Content-Length", str(len(body)))
                 handler.end_headers()
                 handler.wfile.write(body)
@@ -128,6 +182,27 @@ class StatsServer:
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+
+    def _render_metrics(self) -> tuple[bytes, str]:
+        """Prometheus text: the stats dict flattened to gauges, plus the
+        telemetry hub's counters and histograms when attached."""
+        from skyline_tpu.telemetry import (
+            PROMETHEUS_CONTENT_TYPE,
+            flatten_gauges,
+            render_prometheus,
+        )
+
+        stats = self._callback()
+        # latency summaries are already exposed as real histogram series
+        # below; don't double-flatten their p50/p99 into gauges
+        gauges = flatten_gauges(
+            {k: v for k, v in stats.items() if k != "latency_ms"}
+        )
+        if self.telemetry is not None:
+            body = self.telemetry.render_prometheus(gauges=gauges)
+        else:
+            body = render_prometheus(gauges=gauges)
+        return body.encode(), PROMETHEUS_CONTENT_TYPE
 
     def close(self) -> None:
         self._server.shutdown()

@@ -19,6 +19,11 @@ operator observability; this one serves the skyline itself. Endpoints:
                   (re-baseline with GET /skyline).
   GET  /healthz   readiness probe.
   GET  /stats     worker + engine counters plus serve-plane counters.
+  GET  /metrics   Prometheus text exposition (admission counters, snapshot
+                  store gauges, latency histograms incl. serve read p50/p99).
+  GET  /trace     Chrome trace-event JSON of the telemetry span ring
+                  (Perfetto-loadable): ingest → local → merge → publish
+                  spans per query when the worker shares its hub here.
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -32,10 +37,16 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from collections import deque
 from urllib.parse import parse_qs, urlsplit
 
 from skyline_tpu.serve.admission import AdmissionController
+from skyline_tpu.telemetry import (
+    PROMETHEUS_CONTENT_TYPE,
+    Telemetry,
+    flatten_gauges,
+)
 
 _MAX_HEADER = 16_384
 _MAX_BODY = 1_048_576
@@ -148,6 +159,13 @@ class QueryBridge:
         with self._lock:
             return len(self._to_inject) + len(self._awaiting)
 
+    @property
+    def pending_injections(self) -> int:
+        """Submissions not yet dispatched to the engine (the slice of
+        ``depth`` the next ``inject`` call will actually run)."""
+        with self._lock:
+            return len(self._to_inject)
+
 
 class SkylineServer:
     """The serving-plane HTTP front end (asyncio loop on a daemon thread)."""
@@ -161,12 +179,17 @@ class SkylineServer:
         bridge: QueryBridge | None = None,
         port: int = 0,
         host: str = "127.0.0.1",
+        telemetry=None,
     ):
         self.store = store
         self.deltas = deltas
         self.admission = admission if admission is not None else AdmissionController()
         self.stats_cb = stats_cb
         self.bridge = bridge
+        # the worker shares its hub so engine spans/histograms surface on
+        # /metrics and /trace here; a standalone server gets its own (the
+        # read-latency histogram still works)
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._startup_error: BaseException | None = None
@@ -259,10 +282,22 @@ class SkylineServer:
             )
         elif path == "/stats" and method == "GET":
             await self._reply(writer, 200, self._stats())
+        elif path == "/metrics" and method == "GET":
+            await self._metrics(writer)
+        elif path == "/trace" and method == "GET":
+            await self._reply(writer, 200, self.telemetry.spans.to_chrome())
         elif path == "/skyline" and method == "GET":
+            t0 = time.perf_counter_ns()
             await self._skyline(writer, params)
+            self.telemetry.histogram("serve_read_ms").observe(
+                (time.perf_counter_ns() - t0) / 1e6
+            )
         elif path == "/deltas" and method == "GET":
+            t0 = time.perf_counter_ns()
             await self._deltas(writer, params)
+            self.telemetry.histogram("serve_read_ms").observe(
+                (time.perf_counter_ns() - t0) / 1e6
+            )
         elif path == "/query" and method == "POST":
             await self._query(writer)
         else:
@@ -282,6 +317,24 @@ class SkylineServer:
         return out
 
     # -- endpoints ---------------------------------------------------------
+
+    async def _metrics(self, writer):
+        """Prometheus text exposition: admission counters (as counters),
+        snapshot-store / delta-ring stats (as gauges), histograms."""
+        gauges = flatten_gauges({"snapshot_store": self.store.stats()})
+        if self.deltas is not None:
+            gauges.update(flatten_gauges({"delta_ring": self.deltas.stats()}))
+        if self.bridge is not None:
+            gauges["serve_bridge_depth"] = float(self.bridge.depth)
+        gauges["serve_query_depth"] = float(self.admission.queries.depth)
+        counters = {
+            f"serve_{k}": v
+            for k, v in self.admission.counters.snapshot().items()
+        }
+        body = self.telemetry.render_prometheus(
+            gauges=gauges, extra_counters=counters
+        ).encode()
+        await self._reply_raw(writer, 200, body, PROMETHEUS_CONTENT_TYPE)
 
     async def _skyline(self, writer, params):
         ok, retry = self.admission.admit_read()

@@ -122,6 +122,10 @@ class _QueryState:
     start_times: dict = field(default_factory=dict)
     cpu_ms: dict = field(default_factory=dict)
     last_arrival_ms: float = 0.0
+    # telemetry plane: trace id minted at trigger ingestion + the perf
+    # clock at dispatch, so the end-to-end "query" span is recordable
+    trace_id: str | None = None
+    span_t0_ns: int = 0
 
 
 class SkylineEngine:
@@ -132,7 +136,7 @@ class SkylineEngine:
     ``poll_results`` (each result is a dict with the reference's JSON fields).
     """
 
-    def __init__(self, config: EngineConfig, mesh=None, tracer=None):
+    def __init__(self, config: EngineConfig, mesh=None, tracer=None, telemetry=None):
         """``mesh``: optional ``jax.sharding.Mesh`` — logical partitions are
         then sharded across its devices (local flushes run SPMD, one launch
         for the whole set) and the global merge runs as the sharded
@@ -145,12 +149,19 @@ class SkylineEngine:
         ``tracer``: optional ``metrics.tracing.Tracer`` — wires the
         per-phase breakdown (route / flush kernels / snapshot transfer /
         global merge) the reference surfaces as a product feature
-        (SURVEY.md §5); ``None`` costs nothing."""
+        (SURVEY.md §5); ``None`` costs nothing.
+
+        ``telemetry``: optional ``telemetry.Telemetry`` hub — adds latency
+        histograms (ingest batch / global merge / query latency), a
+        ``trace_id`` per query, and per-phase spans into the hub's bounded
+        ring (exported via ``GET /trace`` / ``--trace-out``); ``None``
+        (default) records nothing."""
         from skyline_tpu.metrics.tracing import NULL_TRACER
 
         self.config = config
         self.mesh = mesh
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.telemetry = telemetry
         # resolve the ingest path: device ingest moves routing/sort/block
         # slicing onto the accelerator (stream/device_window.py); it
         # requires single-device lazy/overlap and no grid prefilter (the
@@ -223,6 +234,22 @@ class SkylineEngine:
 
         ids: (N,) int64 global record ids; values: (N, d) float32.
         """
+        tel = self.telemetry
+        if tel is None:
+            return self._process_records(ids, values, now_ms)
+        t0 = time.perf_counter_ns()
+        try:
+            return self._process_records(ids, values, now_ms)
+        finally:
+            end = time.perf_counter_ns()
+            tel.histogram("ingest_batch_ms").observe((end - t0) / 1e6)
+            tel.spans.record(
+                "ingest", t0, end, args={"rows": int(values.shape[0])}
+            )
+
+    def _process_records(
+        self, ids: np.ndarray, values: np.ndarray, now_ms: float | None = None
+    ) -> None:
         if values.shape[0] == 0:
             return
         if now_ms is None:
@@ -319,6 +346,9 @@ class SkylineEngine:
             self.pset.sync_ingest_bookkeeping()
         qid, required = parse_trigger(payload)
         q = _QueryState(qid=qid, payload=payload, required=required, dispatch_ms=now_ms)
+        if self.telemetry is not None:
+            q.trace_id = self.telemetry.mint_trace_id()
+            q.span_t0_ns = time.perf_counter_ns()
         self._inflight[payload] = q
         all_ready = all(
             part.max_seen_id >= required or part.max_seen_id == -1
@@ -364,7 +394,13 @@ class SkylineEngine:
         part = self.partitions[p]
         t0 = time.perf_counter_ns()
         local = part.snapshot()
-        arrival_ms = now_ms + (time.perf_counter_ns() - t0) / 1e6
+        t1 = time.perf_counter_ns()
+        if self.telemetry is not None:
+            self.telemetry.spans.record(
+                "local", t0, t1, trace_id=q.trace_id, tid=p,
+                args={"rows": int(local.shape[0])},
+            )
+        arrival_ms = now_ms + (t1 - t0) / 1e6
         start = part.start_time_ms if part.start_time_ms is not None else now_ms
         q.partials[p] = local
         q.local_sizes[p] = local.shape[0]
@@ -411,7 +447,15 @@ class SkylineEngine:
             origins[keep], minlength=self.config.num_partitions
         )
 
-        merge_ms = (time.perf_counter_ns() - merge_t0) / 1e6
+        merge_end_ns = time.perf_counter_ns()
+        merge_ms = (merge_end_ns - merge_t0) / 1e6
+        if self.telemetry is not None:
+            self.telemetry.spans.record(
+                "merge", merge_t0, merge_end_ns, trace_id=q.trace_id,
+                args={"union_rows": int(union.shape[0]),
+                      "skyline_size": int(global_sky.shape[0])},
+            )
+            self.telemetry.histogram("global_merge_ms").observe(merge_ms)
         now = now_ms + merge_ms
         job_start = min(q.start_times.values()) if q.start_times else now
         # a pure-timeout finalize may have zero arrivals; anchor to now
@@ -432,7 +476,7 @@ class SkylineEngine:
         )
 
         if self.snapshots is not None:
-            self.snapshots.publish(global_sky, query_id=q.qid)
+            self._publish_snapshot(global_sky, q)
         self._emit_result(
             q,
             skyline_size=int(global_sky.shape[0]),
@@ -444,6 +488,21 @@ class SkylineEngine:
             latency_ms=latency_ms,
             points=global_sky if self.config.emit_skyline_points else None,
             partial_missing=partial_missing,
+        )
+
+    def _publish_snapshot(self, points, q: _QueryState) -> None:
+        """Publish a completed global skyline, stamped with the query's
+        trace id and wrapped in a "publish" span when telemetry is on."""
+        meta = {"query_id": q.qid}
+        if q.trace_id is not None:
+            meta["trace_id"] = q.trace_id
+        if self.telemetry is None:
+            self.snapshots.publish(points, **meta)
+            return
+        t0 = time.perf_counter_ns()
+        self.snapshots.publish(points, **meta)
+        self.telemetry.spans.record(
+            "publish", t0, time.perf_counter_ns(), trace_id=q.trace_id
         )
 
     def _emit_result(
@@ -478,6 +537,19 @@ class SkylineEngine:
             result["skyline_points"] = (
                 points.tolist() if hasattr(points, "tolist") else points
             )
+        if self.telemetry is not None:
+            if q.trace_id is not None:
+                # optional wire extension field: format_result appends it
+                # after the reference's fields, so parity consumers are
+                # unaffected (bridge/wire.py)
+                result["trace_id"] = q.trace_id
+            self.telemetry.histogram("query_latency_ms").observe(latency_ms)
+            if q.span_t0_ns:
+                self.telemetry.spans.record(
+                    "query", q.span_t0_ns, time.perf_counter_ns(),
+                    trace_id=q.trace_id,
+                    args={"query_id": q.qid, "skyline_size": skyline_size},
+                )
         self._results.append(result)
         self._inflight.pop(q.payload, None)
 
@@ -491,9 +563,17 @@ class SkylineEngine:
         Timing decomposition follows the same clock discipline as
         _answer/_finalize: the flush wall advances the arrival clock (local
         phase); the merge wall rides on top (global phase)."""
+        tel = self.telemetry
         t0 = time.perf_counter_ns()
         self.pset.flush_all()
-        flush_wall_ms = (time.perf_counter_ns() - t0) / 1e6
+        flush_end_ns = time.perf_counter_ns()
+        flush_wall_ms = (flush_end_ns - t0) / 1e6
+        if tel is not None:
+            # one stacked launch covers every partition's local skyline
+            tel.spans.record(
+                "local", t0, flush_end_ns, trace_id=q.trace_id,
+                args={"partitions": self.config.num_partitions},
+            )
         t1 = time.perf_counter_ns()
         # an attached snapshot store needs the materialized points even when
         # the result JSON omits them — the snapshot IS the serving read path
@@ -503,9 +583,16 @@ class SkylineEngine:
         counts, surv, g, pts = self.pset.global_merge_stats(
             emit_points=want_points
         )
-        merge_ms = (time.perf_counter_ns() - t1) / 1e6
+        merge_end_ns = time.perf_counter_ns()
+        merge_ms = (merge_end_ns - t1) / 1e6
+        if tel is not None:
+            tel.spans.record(
+                "merge", t1, merge_end_ns, trace_id=q.trace_id,
+                args={"skyline_size": int(g)},
+            )
+            tel.histogram("global_merge_ms").observe(merge_ms)
         if self.snapshots is not None:
-            self.snapshots.publish(pts, query_id=q.qid)
+            self._publish_snapshot(pts, q)
 
         starts = [s for s in self.pset.start_time_ms if s is not None]
         map_finish = now_ms + flush_wall_ms

@@ -113,6 +113,7 @@ class SlidingEngine:
         mesh=None,
         emit_per_slide: bool = False,
         tracer=None,
+        telemetry=None,
     ):
         if window_size % slide != 0:
             raise ValueError(
@@ -125,6 +126,9 @@ class SlidingEngine:
         self.mesh = mesh
         self.emit_per_slide = emit_per_slide
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # same contract as SkylineEngine: optional telemetry hub for
+        # latency histograms, per-query trace ids, and spans
+        self.telemetry = telemetry
         P = config.num_partitions
         # start capacity at the balanced-routing bucket (2x headroom over
         # slide/P); grows when routing skew overflows it
@@ -195,6 +199,20 @@ class SlidingEngine:
     def process_records(self, ids, values, now_ms: float | None = None) -> None:
         """Split the batch at global slide boundaries, route each segment,
         close slides as they fill."""
+        tel = self.telemetry
+        if tel is None:
+            return self._process_records(ids, values, now_ms)
+        t0 = time.perf_counter_ns()
+        try:
+            return self._process_records(ids, values, now_ms)
+        finally:
+            end = time.perf_counter_ns()
+            tel.histogram("ingest_batch_ms").observe((end - t0) / 1e6)
+            tel.spans.record(
+                "ingest", t0, end, args={"rows": int(values.shape[0])}
+            )
+
+    def _process_records(self, ids, values, now_ms: float | None = None) -> None:
         if values.shape[0] == 0:
             return
         if now_ms is None:
@@ -329,6 +347,9 @@ class SlidingEngine:
         q = _QueryState(
             qid=qid, payload=payload, required=required, dispatch_ms=now_ms
         )
+        if self.telemetry is not None:
+            q.trace_id = self.telemetry.mint_trace_id()
+            q.span_t0_ns = time.perf_counter_ns()
         self._inflight[payload] = q
         ready = all(
             self.max_seen_id[p] >= required or self.max_seen_id[p] == -1
@@ -422,7 +443,14 @@ class SlidingEngine:
         )
         global_sky = union[keep]
         surv = np.bincount(origins[keep], minlength=P)
-        merge_ms = (time.perf_counter_ns() - t0) / 1e6
+        merge_end_ns = time.perf_counter_ns()
+        merge_ms = (merge_end_ns - t0) / 1e6
+        if self.telemetry is not None:
+            self.telemetry.spans.record(
+                "merge", t0, merge_end_ns, trace_id=q.trace_id,
+                args={"skyline_size": int(global_sky.shape[0])},
+            )
+            self.telemetry.histogram("global_merge_ms").observe(merge_ms)
         now = now_ms + merge_ms
 
         starts = [s for s in self.start_time_ms if s is not None]
@@ -447,12 +475,34 @@ class SlidingEngine:
         if self.config.emit_skyline_points:
             result["skyline_points"] = global_sky.tolist()
         if self.snapshots is not None:
+            meta = {}
+            if q.trace_id is not None:
+                meta["trace_id"] = q.trace_id
+            p0 = time.perf_counter_ns()
             self.snapshots.publish(
                 global_sky,
                 query_id=q.qid,
                 slides_closed=self._slides_closed,
                 window_filled=self._slides_closed >= self.k,
+                **meta,
             )
+            if self.telemetry is not None:
+                self.telemetry.spans.record(
+                    "publish", p0, time.perf_counter_ns(), trace_id=q.trace_id
+                )
+        if self.telemetry is not None:
+            if q.trace_id is not None:
+                result["trace_id"] = q.trace_id
+            self.telemetry.histogram("query_latency_ms").observe(
+                result["query_latency_ms"]
+            )
+            if q.span_t0_ns:
+                self.telemetry.spans.record(
+                    "query", q.span_t0_ns, time.perf_counter_ns(),
+                    trace_id=q.trace_id,
+                    args={"query_id": q.qid,
+                          "skyline_size": int(global_sky.shape[0])},
+                )
         self._results.append(result)
         self._inflight.pop(q.payload, None)
         return now

@@ -1,0 +1,101 @@
+"""Unified telemetry plane: histograms, per-query spans, Prometheus export.
+
+This package absorbs and extends ``skyline_tpu/metrics`` (which keeps the
+reference-parity pieces: the result-CSV collector, ``Counters``, the
+phase-total ``Tracer``, and the /stats HTTP server) with the three pillars
+the serving north star needs:
+
+- ``histogram.Histogram`` — lock-cheap fixed-bucket latency distributions
+  (ingest batch, query latency, global merge, serve reads) with p50/p90/p99
+  estimation; the single percentile implementation ``bench.py`` reports.
+- ``spans.SpanRecorder`` — a bounded ring of per-query spans keyed by a
+  ``trace_id`` minted at trigger ingestion, exportable as Chrome
+  trace-event JSON (``GET /trace``, ``--trace-out``) for Perfetto.
+- ``prometheus.render`` — standard text exposition behind ``GET /metrics``
+  on both the stats and serving servers.
+
+``Telemetry`` bundles all three plus a ``Counters`` instance so the worker,
+engine, and both HTTP servers share one hub object.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from skyline_tpu.metrics.collector import Counters
+from skyline_tpu.metrics.tracing import NULL_TRACER, Tracer
+from skyline_tpu.telemetry.histogram import DEFAULT_EDGES, Histogram
+from skyline_tpu.telemetry.prometheus import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
+)
+from skyline_tpu.telemetry.prometheus import flatten_gauges
+from skyline_tpu.telemetry.prometheus import render as render_prometheus
+from skyline_tpu.telemetry.spans import SpanRecorder, mint_trace_id
+
+
+class Telemetry:
+    """One shared hub: counters + named histograms + the span ring.
+
+    The worker owns one and threads it through the engine and both HTTP
+    servers; everything on it is safe from any thread. ``histogram`` is
+    get-or-create so call sites never coordinate registration.
+    """
+
+    def __init__(self, span_capacity: int = 4096):
+        self.counters = Counters()
+        self.spans = SpanRecorder(span_capacity)
+        self._hists: dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def histogram(self, name: str, unit: str = "ms") -> Histogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.get(name)
+                if h is None:
+                    h = Histogram(name, unit=unit)
+                    self._hists[name] = h
+        return h
+
+    def histograms(self) -> list[Histogram]:
+        with self._lock:
+            return list(self._hists.values())
+
+    def mint_trace_id(self) -> str:
+        return mint_trace_id()
+
+    def latency_snapshot(self) -> dict[str, dict]:
+        """{hist name: {count, mean, p50, p90, p99, ...}} for /stats and
+        the dashboard's percentile tiles."""
+        return {h.name: h.snapshot() for h in self.histograms()}
+
+    def render_prometheus(
+        self,
+        gauges: dict[str, float] | None = None,
+        extra_counters: dict[str, float] | None = None,
+        prefix: str = "skyline",
+    ) -> str:
+        counters = dict(self.counters.snapshot())
+        if extra_counters:
+            counters.update(extra_counters)
+        return render_prometheus(
+            counters=counters,
+            gauges=gauges,
+            histograms=self.histograms(),
+            prefix=prefix,
+        )
+
+
+__all__ = [
+    "Counters",
+    "DEFAULT_EDGES",
+    "Histogram",
+    "NULL_TRACER",
+    "PROMETHEUS_CONTENT_TYPE",
+    "SpanRecorder",
+    "Telemetry",
+    "Tracer",
+    "flatten_gauges",
+    "mint_trace_id",
+    "render_prometheus",
+]

@@ -1,0 +1,74 @@
+"""Prometheus text exposition (format 0.0.4) for counters/gauges/histograms.
+
+Stdlib-only renderer for the ``GET /metrics`` endpoints on both HTTP
+servers (``metrics/httpstats.py`` and ``serve/server.py``). Conventional
+naming: monotonic counters get a ``_total`` suffix, histograms expand to
+``_bucket{le=...}`` / ``_sum`` / ``_count`` series, and every metric is
+prefixed (default ``skyline_``) and sanitized to the Prometheus name
+charset. Nested stats dicts flatten with ``_`` joins, so
+``{"serve": {"reads_shed": 3}}`` exposes as ``skyline_serve_reads_shed``.
+"""
+
+from __future__ import annotations
+
+import re
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    out = _NAME_RE.sub("_", str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def flatten_gauges(doc: dict, prefix: str = "") -> dict[str, float]:
+    """Flatten a nested stats dict into gauge samples: numbers kept (bools
+    as 0/1), strings/lists/None dropped, sub-dicts joined with ``_``."""
+    out: dict[str, float] = {}
+    for k, v in doc.items():
+        key = f"{prefix}_{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_gauges(v, key))
+        elif isinstance(v, bool):
+            out[key] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out
+
+
+def render(
+    counters: dict[str, float] | None = None,
+    gauges: dict[str, float] | None = None,
+    histograms=None,
+    prefix: str = "skyline",
+) -> str:
+    """Render one exposition document. ``histograms`` is an iterable of
+    ``telemetry.histogram.Histogram``."""
+    lines: list[str] = []
+    for name in sorted(counters or {}):
+        m = f"{prefix}_{sanitize(name)}_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(counters[name])}")
+    for name in sorted(gauges or {}):
+        m = f"{prefix}_{sanitize(name)}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(gauges[name])}")
+    for h in histograms or ():
+        m = f"{prefix}_{sanitize(h.name)}"
+        lines.append(f"# TYPE {m} histogram")
+        for le, cum in h.bucket_counts():
+            lines.append(f'{m}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f"{m}_sum {repr(float(h.sum))}")
+        lines.append(f"{m}_count {h.count}")
+    return "\n".join(lines) + "\n"

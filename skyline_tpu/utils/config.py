@@ -62,6 +62,11 @@ class JobConfig:
     serve_query_deadline_ms: float = 10_000.0
     serve_delta_ring: int = 128  # retained snapshot transitions
     serve_history: int = 64  # retained snapshot versions
+    # observability (skyline_tpu/telemetry): Chrome trace-event export of
+    # the per-query span ring, and opt-in device profiling of forced merges
+    trace_out: str = ""  # write span ring as Chrome trace JSON on close
+    trace_ring: int = 4096  # span ring capacity
+    jax_profile_dir: str = ""  # wrap each POST /query injection in jax.profiler.trace
 
     def __post_init__(self):
         if self.parallelism < 1:
@@ -130,6 +135,10 @@ class JobConfig:
             raise ValueError(
                 "serve_delta_ring and serve_history must be >= 1, got "
                 f"{self.serve_delta_ring} / {self.serve_history}"
+            )
+        if self.trace_ring < 1:
+            raise ValueError(
+                f"trace_ring must be >= 1, got {self.trace_ring}"
             )
         # the over-partitioning factor is owned by EngineConfig; validate
         # against it rather than a duplicated literal
@@ -318,6 +327,21 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=_env_int("SERVE_HISTORY",
                                      defaults.serve_history),
                     help="snapshot versions retained in the store")
+    ap.add_argument("--trace-out",
+                    default=os.environ.get("SKYLINE_TRACE_OUT",
+                                           defaults.trace_out),
+                    help="write the per-query span ring as Chrome "
+                         "trace-event JSON to this path on shutdown "
+                         "(load at https://ui.perfetto.dev)")
+    ap.add_argument("--trace-ring", type=int,
+                    default=_env_int("TRACE_RING", defaults.trace_ring),
+                    help="span ring capacity (most recent spans kept)")
+    ap.add_argument("--jax-profile-dir",
+                    default=os.environ.get("SKYLINE_JAX_PROFILE_DIR",
+                                           defaults.jax_profile_dir),
+                    help="opt-in: wrap each forced-query injection "
+                         "(POST /query) in jax.profiler.trace writing to "
+                         "this directory")
     a = ap.parse_args(argv)
     return JobConfig(
         parallelism=a.parallelism,
@@ -350,6 +374,9 @@ def parse_job_args(argv=None) -> JobConfig:
         serve_query_deadline_ms=a.serve_query_deadline_ms,
         serve_delta_ring=a.serve_delta_ring,
         serve_history=a.serve_history,
+        trace_out=a.trace_out,
+        trace_ring=a.trace_ring,
+        jax_profile_dir=a.jax_profile_dir,
     )
 
 

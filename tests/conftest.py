@@ -47,3 +47,56 @@ def sorted_rows(a):
 
 def assert_same_set(a, b):
     np.testing.assert_allclose(sorted_rows(a), sorted_rows(b))
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Minimal Prometheus text-exposition (0.0.4) parser for assertions.
+
+    Returns ``{metric_name: [(labels_dict, float_value), ...]}`` and
+    raises AssertionError on any malformed line — the tests' contract
+    that /metrics stays scrapeable. Handles ``# TYPE``/``# HELP``
+    comments, label sets, and ``+Inf``/``-Inf``/``NaN`` values.
+    """
+    series: dict = {}
+    types: dict = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            assert len(parts) >= 3 and parts[1] in ("TYPE", "HELP"), (
+                f"malformed comment line: {raw!r}"
+            )
+            if parts[1] == "TYPE":
+                assert parts[3] in (
+                    "counter", "gauge", "histogram", "summary", "untyped"
+                ), f"bad TYPE: {raw!r}"
+                types[parts[2]] = parts[3]
+            continue
+        head, _, val = line.rpartition(" ")
+        assert head, f"malformed sample line: {raw!r}"
+        labels: dict = {}
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            assert rest.endswith("}"), f"malformed labels: {raw!r}"
+            for pair in filter(None, rest[:-1].split(",")):
+                k, _, v = pair.partition("=")
+                assert v.startswith('"') and v.endswith('"'), (
+                    f"unquoted label value: {raw!r}"
+                )
+                labels[k] = v[1:-1]
+        else:
+            name = head
+        assert name and name[0] not in "0123456789", f"bad name: {raw!r}"
+        assert all(
+            c.isalnum() or c in "_:" for c in name
+        ), f"bad metric name char: {raw!r}"
+        series.setdefault(name, []).append((labels, float(val)))
+    series["__types__"] = types
+    return series
+
+
+@pytest.fixture
+def prom_parse():
+    return parse_prometheus_text

@@ -43,8 +43,12 @@ def test_full_pipeline_over_memory_bus(rng, tmp_path):
     assert n == 1
     with open(out_csv) as f:
         rows = list(csv.reader(f))
-    assert rows[0] == CSV_HEADERS
-    row = dict(zip(CSV_HEADERS, rows[1]))
+    # the worker's telemetry hub stamps a trace_id on every result, so the
+    # collector appends its TraceID column (absent for untraced streams —
+    # byte-stability covered in tests/test_telemetry.py)
+    assert rows[0] == CSV_HEADERS + ["TraceID"]
+    row = dict(zip(rows[0], rows[1]))
+    assert row["TraceID"]
     assert row["QueryID"] == "0"
     assert row["Records"] == "4900"
     assert int(row["SkylineSize"]) == skyline_np(x).shape[0]

@@ -187,8 +187,10 @@ def test_sliding_worker_e2e_to_collector_csv(rng, tmp_path):
     assert n == 1
     with open(out_csv) as f:
         rows = list(csv.reader(f))
-    assert rows[0] == CSV_HEADERS
-    row = dict(zip(CSV_HEADERS, rows[1]))
+    # worker results carry a trace_id, so the collector's TraceID column
+    # rides along (see tests/test_telemetry.py for the untraced shape)
+    assert rows[0] == CSV_HEADERS + ["TraceID"]
+    row = dict(zip(rows[0], rows[1]))
     oracle = skyline_np(_window_oracle(x, 2600, 1000, 500))
     assert int(row["SkylineSize"]) == oracle.shape[0]
     assert worker.stats()["mode"] == "sliding"
