@@ -95,6 +95,36 @@ def run_window(cfg, ids, x, required, tracer=None):
     return dt, result
 
 
+def merge_cache_leg(cfg, ids, x, required) -> dict:
+    """Merge-cache truth for the bench artifact: ONE persistent engine,
+    trigger twice over an unchanged window (cold miss + exact hit), then a
+    small top-up and a third trigger (dirty-subset delta merge). Stamps
+    hit/miss/delta counters and the last dirty fraction as a
+    ``phase_breakdown_ms`` sibling so ``scripts/bench_compare.py`` can gate
+    on the cache staying live; the full/delta/hit latency A/B lives in
+    ``benchmarks/merge_cache.py``."""
+    from skyline_tpu.stream import SkylineEngine
+
+    eng = SkylineEngine(cfg)
+    n = x.shape[0]
+    chunk = 65536
+    for i in range(0, n, chunk):
+        eng.process_records(ids[i : i + chunk], x[i : i + chunk])
+    for _ in range(2):  # cold miss, then exact epoch-key hit
+        eng.process_trigger(f"0,{required}")
+        eng.poll_results()
+    # one repeated point routes to exactly ONE partition, so the third
+    # trigger exercises the dirty-subset delta path, not another full merge
+    m = max(1, n // 64)
+    eng.process_records(ids[:m], np.repeat(x[:1], m, axis=0))
+    eng.process_trigger(f"0,{required}")
+    eng.poll_results()
+    mc = eng.stats()["merge_cache"]
+    total = mc["hits"] + mc["misses"]
+    mc["hit_rate"] = round(mc["hits"] / total, 3) if total else 0.0
+    return mc
+
+
 def serve_leg(d: int, algo: str) -> dict:
     """Serving-plane microbenchmark: read latency p50/p99 and shed rate.
 
@@ -314,6 +344,12 @@ def child_main(backend: str) -> None:
             serve = {"error": f"{type(e).__name__}: {e}"}
     else:
         serve = {"skipped": True}
+    try:
+        merge_cache = merge_cache_leg(
+            cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
+        )
+    except Exception as e:  # pragma: no cover - diagnostic path
+        merge_cache = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -338,6 +374,7 @@ def child_main(backend: str) -> None:
                 "serve": serve,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
+                "merge_cache": merge_cache,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }
         )

@@ -1,0 +1,159 @@
+"""A/B: from-scratch full global merge vs the incremental paths the
+epoch-keyed cache enables (ISSUE 3 tentpole) — exact cache hit (zero
+kernel launches) and dirty-subset delta merge (``cached_global ∪ dirty
+skylines`` instead of the full union).
+
+For each (n, d) at P partitions, drives a ``PartitionSet`` directly (no
+engine, so the measurement is the merge itself):
+
+- full:  ``SKYLINE_MERGE_CACHE=0``, every trigger recomputes the union
+- hit:   cache primed, repeated triggers over unchanged state
+- delta: one partition dirtied per trigger (the steady-streaming shape)
+
+Each delta result is asserted byte-identical to a cache-off full
+recompute of the same state (the randomized interleaving property test
+lives in tests/test_merge_cache.py). Writes
+``artifacts/merge_cache_ab.json``.
+
+Usage: python benchmarks/merge_cache.py [--repeats 5] [--sizes ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _timed(fn, repeats: int) -> float:
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1000.0)
+
+
+def bench_one(n: int, d: int, P: int, repeats: int) -> dict:
+    from skyline_tpu.stream.batched import PartitionSet
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    x = anti_correlated(rng, n, d, 0, 10000).astype(np.float32)
+    pids = rng.integers(0, P, n)
+    pset = PartitionSet(P, d, buffer_size=max(n, 1024))
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=n, now_ms=0.0)
+    pset.flush_all()
+
+    # full: every trigger pays the whole union (the pre-cache behavior)
+    os.environ["SKYLINE_MERGE_CACHE"] = "0"
+    pset.global_merge_stats(emit_points=True)  # warm the executables
+    full_ms = _timed(
+        lambda: pset.global_merge_stats(emit_points=True), repeats
+    )
+
+    # hit: primed cache, unchanged state — no kernel launches at all
+    os.environ["SKYLINE_MERGE_CACHE"] = "1"
+    pset.global_merge_stats(emit_points=True)  # prime (counts as a miss)
+    hit_ms = _timed(
+        lambda: pset.global_merge_stats(emit_points=True), repeats
+    )
+
+    # delta: dirty ONE partition per trigger; the flush runs outside the
+    # timed region so the number is the merge, not the top-up
+    def dirty_round(measure: bool) -> float:
+        pset.add_batch(
+            0,
+            anti_correlated(rng, 256, d, 0, 10000).astype(np.float32),
+            max_id=n,
+            now_ms=0.0,
+        )
+        pset.flush_all()
+        t0 = time.perf_counter()
+        res = pset.global_merge_stats(emit_points=True)
+        dt = time.perf_counter() - t0
+        if measure:
+            os.environ["SKYLINE_MERGE_CACHE"] = "0"
+            ref = pset.global_merge_stats(emit_points=True)
+            os.environ["SKYLINE_MERGE_CACHE"] = "1"
+            assert res[2] == ref[2], (res[2], ref[2])
+            assert res[3].tobytes() == ref[3].tobytes(), (
+                f"delta diverges from full recompute at n={n} d={d}"
+            )
+        return dt
+
+    dirty_round(measure=False)  # warm the delta executables
+    delta_ms = float(
+        np.median([dirty_round(measure=True) for _ in range(repeats)]) * 1000.0
+    )
+
+    g = pset.global_merge_stats()[2]
+    return {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "skyline_size": int(g),
+        "full_ms": round(full_ms, 2),
+        "cache_hit_ms": round(hit_ms, 3),
+        "delta_ms": round(delta_ms, 2),
+        "hit_speedup": round(full_ms / hit_ms, 1) if hit_ms else None,
+        "delta_speedup": round(full_ms / delta_ms, 2) if delta_ms else None,
+        "cache_hits": pset.merge_cache_hits,
+        "cache_misses": pset.merge_cache_misses,
+        "delta_merges": pset.merge_delta_merges,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--sizes", type=int, nargs="+", default=[65536, 262144])
+    ap.add_argument("--dims", type=int, nargs="+", default=[8])
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--out", default="artifacts/merge_cache_ab.json")
+    a = ap.parse_args(argv)
+
+    import jax
+
+    # belt and braces (same as run_configs.py): JAX_PLATFORMS=cpu alone has
+    # been observed to still initialize the axon TPU plugin, which hangs
+    # when the tunnel is down — the config update actually pins the backend
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    prev = os.environ.get("SKYLINE_MERGE_CACHE")
+    results = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "rows": [],
+    }
+    try:
+        for n in a.sizes:
+            for d in a.dims:
+                row = bench_one(n, d, a.partitions, a.repeats)
+                print(json.dumps(row), flush=True)
+                results["rows"].append(row)
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLINE_MERGE_CACHE", None)
+        else:
+            os.environ["SKYLINE_MERGE_CACHE"] = prev
+    if a.out:
+        os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
+        with open(a.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

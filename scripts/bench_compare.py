@@ -10,6 +10,7 @@ non-zero when any regresses past ``--threshold`` (default 25%):
   p50_window_latency_ms  end-to-end p50    higher is a regression
   serve.read_p50_ms      serve read p50    higher is a regression
   serve.read_p99_ms      serve read p99    higher is a regression
+  merge_cache.hit_rate   merge-cache leg   lower is a regression
 
 A metric missing from either artifact (e.g. the serve leg was skipped) is
 reported as ``skipped`` and never fails the gate. Runs on different
@@ -39,6 +40,10 @@ METRICS = (
     ("p50_window_latency_ms", ("p50_window_latency_ms",), False),
     ("serve.read_p50_ms", ("serve", "read_p50_ms"), False),
     ("serve.read_p99_ms", ("serve", "read_p99_ms"), False),
+    # merge-cache leg (bench.py merge_cache_leg): a hit-rate drop means the
+    # epoch-keyed reuse went dead — absent/zero (older artifacts, leg
+    # errored) skips, never fails
+    ("merge_cache.hit_rate", ("merge_cache", "hit_rate"), True),
 )
 
 

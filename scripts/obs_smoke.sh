@@ -86,6 +86,31 @@ try:
     with urllib.request.urlopen(f"{serve_base}/skyline", timeout=5) as r:
         assert json.load(r)["version"] == 1
 
+    # read-side result cache: an identical second read serves the cached
+    # serialized body (and still carries the per-read volatile fields)
+    with urllib.request.urlopen(f"{serve_base}/skyline", timeout=5) as r:
+        doc = json.load(r)
+        assert doc["version"] == 1 and "age_ms" in doc and "stale" in doc
+
+    # merge cache + snapshot dedupe: a second trigger over UNCHANGED state
+    # must hit the epoch-keyed merge cache and dedupe the publish (the
+    # snapshot version stays 1 — no spurious delta, no history churn)
+    bus.produce("queries", format_trigger(1, 0))
+    while worker.step() > 0:
+        pass
+    with urllib.request.urlopen(f"{serve_base}/skyline", timeout=5) as r:
+        assert json.load(r)["version"] == 1, "dedupe minted a version"
+
+    with urllib.request.urlopen(f"{stats_base}/stats", timeout=5) as r:
+        stats = json.load(r)
+    mc = stats["merge_cache"]
+    assert mc["hits"] >= 1 and mc["misses"] >= 1, mc
+    assert stats["serve"]["read_cache_hits"] >= 1, stats["serve"]
+    assert stats["snapshot_store"]["deduped"] >= 1, stats["snapshot_store"]
+    print(f"[obs-smoke] merge cache ok: {mc['hits']} hit(s), "
+          f"{stats['serve']['read_cache_hits']} read-cache hit(s), "
+          f"{stats['snapshot_store']['deduped']} publish dedupe(s)")
+
     for label, base in (("stats", stats_base), ("serve", serve_base)):
         with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
             ctype = r.headers.get("Content-Type", "")
@@ -96,8 +121,14 @@ try:
     with urllib.request.urlopen(f"{stats_base}/metrics", timeout=5) as r:
         body = r.read().decode()
     for want in ("skyline_ingest_batch_ms_bucket",
-                 "skyline_query_latency_ms_count"):
+                 "skyline_query_latency_ms_count",
+                 "skyline_merge_cache_hit_total",
+                 "skyline_merge_cache_miss_total"):
         assert want in body, f"{want} missing from exposition"
+    with urllib.request.urlopen(f"{serve_base}/metrics", timeout=5) as r:
+        serve_body = r.read().decode()
+    assert "skyline_serve_read_cache_hits_total" in serve_body, \
+        "read-cache counter missing from serve exposition"
 
     with urllib.request.urlopen(f"{stats_base}/stats", timeout=5) as r:
         stats = json.load(r)

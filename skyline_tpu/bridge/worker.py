@@ -138,6 +138,7 @@ class SkylineWorker:
                     port=serve_port,
                     host=scfg.host,
                     telemetry=self.telemetry,
+                    read_cache=scfg.read_cache_entries,
                 )
             except OSError as e:
                 # like /stats: the serving plane is optional — a port

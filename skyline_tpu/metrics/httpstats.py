@@ -71,7 +71,11 @@ async function tick() {
       ["device ms", s.processing_ms && Math.round(s.processing_ms)],
       ["meshed", s.meshed],
       ["slides closed", s.slides_closed],
-    ].filter(([, v]) => v !== undefined);
+      ["merge cache hits", s.merge_cache && s.merge_cache.hits],
+      ["merge cache misses", s.merge_cache && s.merge_cache.misses],
+      ["delta merges", s.merge_cache && s.merge_cache.delta_merges],
+      ["dirty fraction", s.merge_cache && s.merge_cache.last_dirty_fraction],
+    ].filter(([, v]) => v !== undefined && v !== null);
     document.getElementById("tiles").innerHTML = tiles.map(
       ([k, v]) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`
     ).join("");
@@ -82,8 +86,10 @@ async function tick() {
       ["stale rejected (503)", sv.stale_rejected || 0],
       ["delta re-baselines (410)", sv.deltas_gone || 0],
       ["queries shed (429)", sv.queries_shed || 0],
+      ["read-cache hits", sv.read_cache_hits],
       ["snapshot version", st && st.head_version],
       ["version lag", st && st.version_lag],
+      ["publishes deduped", st && st.deduped],
     ].filter(([, v]) => v !== undefined);
     document.getElementById("serveblock").style.display =
       serveTiles.length ? "" : "none";

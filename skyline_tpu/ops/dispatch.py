@@ -34,6 +34,52 @@ def rank_cascade() -> bool:
     return os.environ.get("SKYLINE_RANK_CASCADE", "0") != "0"
 
 
+def merge_cache_enabled() -> bool:
+    """``SKYLINE_MERGE_CACHE`` gates the epoch-keyed global-merge cache in
+    ``stream/batched.py``: repeated query triggers between flushes reuse the
+    previous merge's result (zero kernel launches), and partially-dirty
+    states merge ``cached_global ∪ dirty skylines`` instead of the full
+    union. Default ON — results are provably identical (merge law +
+    transitivity, see PartitionSet.global_merge_stats); set ``0`` to force
+    the from-scratch full merge on every trigger (the A/B baseline the
+    equivalence tests and benchmarks/merge_cache.py compare against). Read
+    lazily per query, so tests can flip it per-case."""
+    import os
+
+    return os.environ.get("SKYLINE_MERGE_CACHE", "1") != "0"
+
+
+def delta_dirty_cutoff() -> float:
+    """``SKYLINE_DELTA_CUTOFF``: max dirty-partition fraction for the
+    delta-merge path. Above it the full union merge runs instead — once
+    most partitions changed, ``cached_global ∪ dirty`` approaches the full
+    union anyway and the delta assembly's extra executable shapes (one per
+    dirty pattern) buy nothing. Default 0.75; ``0`` disables delta merges
+    while keeping the exact-hit cache."""
+    import os
+
+    try:
+        return float(os.environ.get("SKYLINE_DELTA_CUTOFF", "0.75"))
+    except ValueError:
+        return 0.75
+
+
+def flush_stage_depth() -> int:
+    """``SKYLINE_STAGE_DEPTH``: how many flush rounds the host stages ahead
+    of the in-flight merge kernel (assemble + device_put issued before the
+    previous round's kernel is awaited). 1 = double buffering (default);
+    higher values deepen the pipeline at the cost of that many staged
+    micro-batches resident in host+device memory; 0 disables staging
+    (assemble-then-dispatch strictly in order, the pre-pipelining
+    behavior)."""
+    import os
+
+    try:
+        return max(0, int(os.environ.get("SKYLINE_STAGE_DEPTH", "1")))
+    except ValueError:
+        return 1
+
+
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
     if x.shape[1] <= 2:

@@ -38,7 +38,7 @@ import asyncio
 import json
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from urllib.parse import parse_qs, urlsplit
 
 from skyline_tpu.serve.admission import AdmissionController
@@ -66,6 +66,7 @@ class ServeConfig:
         query_deadline_ms: float = 10_000.0,
         delta_ring: int = 128,
         history: int = 64,
+        read_cache_entries: int = 64,
     ):
         self.port = port
         self.host = host
@@ -76,6 +77,7 @@ class ServeConfig:
         self.query_deadline_ms = query_deadline_ms
         self.delta_ring = delta_ring
         self.history = history
+        self.read_cache_entries = read_cache_entries
 
     def admission(self, counters=None) -> AdmissionController:
         return AdmissionController(
@@ -180,12 +182,22 @@ class SkylineServer:
         port: int = 0,
         host: str = "127.0.0.1",
         telemetry=None,
+        read_cache: int = 64,
     ):
         self.store = store
         self.deltas = deltas
         self.admission = admission if admission is not None else AdmissionController()
         self.stats_cb = stats_cb
         self.bridge = bridge
+        # read-side result cache: serialized response bodies keyed by
+        # (snapshot version, format/projection) — snapshots are immutable,
+        # so repeated reads of the same version skip re-serialization (the
+        # points tolist + json.dumps dominate big-skyline reads). Every
+        # handler runs on the single asyncio loop thread, so the
+        # OrderedDict LRU needs no lock. ``read_cache`` bounds entries;
+        # 0 disables.
+        self._read_cache: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._read_cache_cap = max(0, int(read_cache))
         # the worker shares its hub so engine spans/histograms surface on
         # /metrics and /trace here; a standalone server gets its own (the
         # read-latency histogram still works)
@@ -316,6 +328,25 @@ class SkylineServer:
             out["serve"]["bridge_depth"] = self.bridge.depth
         return out
 
+    # -- read-side result cache --------------------------------------------
+
+    def _cache_get(self, key) -> bytes | None:
+        body = self._read_cache.get(key)
+        if body is None:
+            self.admission.counters.inc("read_cache_misses")
+            return None
+        self._read_cache.move_to_end(key)
+        self.admission.counters.inc("read_cache_hits")
+        return body
+
+    def _cache_put(self, key, body: bytes) -> None:
+        if self._read_cache_cap == 0:
+            return
+        self._read_cache[key] = body
+        self._read_cache.move_to_end(key)
+        while len(self._read_cache) > self._read_cache_cap:
+            self._read_cache.popitem(last=False)
+
     # -- endpoints ---------------------------------------------------------
 
     async def _metrics(self, writer):
@@ -383,11 +414,15 @@ class SkylineServer:
         self.admission.counters.inc("reads_served")
         snap = rs.snapshot
         if params.get("format") == "csv":
-            from skyline_tpu.bridge.wire import format_tuple_line
+            body = self._cache_get((snap.version, "csv"))
+            if body is None:
+                from skyline_tpu.bridge.wire import format_tuple_line
 
-            body = "\n".join(
-                format_tuple_line(i, row) for i, row in enumerate(snap.points)
-            ).encode()
+                body = "\n".join(
+                    format_tuple_line(i, row)
+                    for i, row in enumerate(snap.points)
+                ).encode()
+                self._cache_put((snap.version, "csv"), body)
             await self._reply_raw(
                 writer,
                 200,
@@ -400,13 +435,26 @@ class SkylineServer:
                 },
             )
             return
-        doc = snap.to_doc(include_points=params.get("points") != "0")
-        doc["age_ms"] = round(rs.age_ms, 1)
-        doc["version_lag"] = rs.version_lag
-        doc["stale"] = not rs.fresh
+        # the snapshot-derived fields are immutable per version, so the
+        # serialized doc caches minus its closing brace; the read-dependent
+        # fields (age/lag/staleness) splice on as a tiny per-request suffix
+        include_points = params.get("points") != "0"
+        prefix = self._cache_get((snap.version, "json", include_points))
+        if prefix is None:
+            prefix = json.dumps(snap.to_doc(include_points=include_points))[
+                :-1
+            ].encode()
+            self._cache_put((snap.version, "json", include_points), prefix)
+        tail = (
+            f', "age_ms": {round(rs.age_ms, 1)}'
+            f', "version_lag": {rs.version_lag}'
+            f', "stale": {"true" if not rs.fresh else "false"}'
+        )
         if refresh_triggered:
-            doc["refresh_triggered"] = True
-        await self._reply(writer, 200, doc)
+            tail += ', "refresh_triggered": true'
+        await self._reply_raw(
+            writer, 200, prefix + tail.encode() + b"}", "application/json"
+        )
 
     async def _deltas(self, writer, params):
         ok, retry = self.admission.admit_read()

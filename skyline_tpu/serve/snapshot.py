@@ -104,6 +104,11 @@ class SnapshotStore:
         self._write_lock = threading.Lock()
         self._subscribers: list = []  # publish callbacks (delta ring, tests)
         self.published = 0
+        # opaque identity of the engine state behind _latest (the partition
+        # epoch key): a publish with the same key is a byte-identical repeat
+        # (merge-cache hit upstream) and dedupes instead of minting a version
+        self._source_key = None
+        self.deduped = 0
 
     # -- writer side (engine thread) --------------------------------------
 
@@ -124,10 +129,26 @@ class SnapshotStore:
         points: np.ndarray,
         watermark_id: int | None = None,
         now_ms: float | None = None,
+        source_key=None,
         **meta,
     ) -> Snapshot:
-        """Freeze ``points`` as the next version and swap it in."""
+        """Freeze ``points`` as the next version and swap it in.
+
+        ``source_key``: optional opaque identity of the source state (the
+        engine's partition-epoch key). Publishing the SAME key as the live
+        snapshot is a no-op returning the existing snapshot — version
+        numbering stays dense, the delta ring sees no spurious full-replace
+        delta, and subscribers don't re-fire for bytes they already have.
+        ``None`` (default) never dedupes."""
         with self._write_lock:
+            if (
+                source_key is not None
+                and self._latest is not None
+                and self._source_key == source_key
+            ):
+                self.deduped += 1
+                self._advances = 0
+                return self._latest
             pts = np.ascontiguousarray(points, dtype=np.float32)
             if pts.base is None or pts is points:
                 pts = pts.copy()  # never alias the engine's buffer
@@ -147,6 +168,7 @@ class SnapshotStore:
             self._history.append(snap)
             self._advances = 0
             self._latest = snap  # the atomic swap readers key off
+            self._source_key = source_key
             self.published += 1
         for cb in self._subscribers:
             cb(prev, snap)
@@ -202,6 +224,7 @@ class SnapshotStore:
         return {
             "head_version": self._version,
             "published": self.published,
+            "deduped": self.deduped,
             "version_lag": self._advances,
             "stream_watermark": self._stream_watermark,
             "history_depth": len(self._history),

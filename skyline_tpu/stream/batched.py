@@ -33,12 +33,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
-from skyline_tpu.ops.dispatch import on_tpu
+from skyline_tpu.ops.dispatch import (
+    delta_dirty_cutoff,
+    flush_stage_depth,
+    merge_cache_enabled,
+    on_tpu,
+)
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
     _MIN_CAP,
     _active_bucket,
     _next_pow2,
+    global_merge_delta_device,
     global_merge_stats_device,
     global_points_device,
     merge_step_active,
@@ -89,6 +95,7 @@ class PartitionSet:
         route: tuple[str, float] | None = None,
         overlap_rows: int = 262144,
         window_capacity: int = 0,
+        counters=None,
     ):
         """``initial_capacity``: pre-size the per-partition skyline buffers
         (rounded up to the power-of-two bucket). Capacity normally grows on
@@ -130,6 +137,12 @@ class PartitionSet:
         slicing all run on device (see stream/device_window.py). ``None``
         keeps the host routing path (the engine routes and calls
         ``add_batch``). Single-device only.
+
+        ``counters``: optional ``metrics.collector.Counters``-like sink
+        (``inc(name, n)``) mirroring the merge-cache counters into the
+        telemetry plane (``merge.cache_hit`` / ``merge.cache_miss`` /
+        ``merge.delta_merge`` / ``merge.delta_rows`` → Prometheus
+        ``skyline_merge_*_total`` on GET /metrics).
         """
         self.num_partitions = num_partitions
         self.dims = dims
@@ -198,6 +211,24 @@ class PartitionSet:
         # partitions) then cost ONE count sync + ONE buffer transfer total
         self._counts_cache: np.ndarray | None = None
         self._host_cache: np.ndarray | None = None
+        # flushed-state versioning: a monotone per-partition epoch, bumped
+        # by every flush path that merges rows into that partition (and by
+        # restore). The epoch vector is the identity of the device state —
+        # the global-merge cache keys on it, and the serving plane dedupes
+        # snapshot publishes against it (epoch_key).
+        self._epoch = np.zeros(p, dtype=np.int64)
+        # epoch-keyed global-merge result cache (see global_merge_stats):
+        # {key, epoch, counts, surv, g, pts_dev, pts_host}
+        self._gm_cache: dict | None = None
+        self._counters = counters
+        self.merge_cache_hits = 0
+        self.merge_cache_misses = 0
+        self.merge_delta_merges = 0
+        self.merge_delta_rows = 0
+        self.last_dirty_fraction: float | None = None
+        # a deferred (async-started) count-bound tighten from the last lazy
+        # flush, consumed by the next sky_counts()/global merge
+        self._tighten_pending = False
 
     def _put(self, arr: np.ndarray):
         """Place a (P, ...) array on device, partition-sharded if meshed."""
@@ -206,6 +237,30 @@ class PartitionSet:
 
             return jax.device_put(arr, self._sharding)
         return jnp.asarray(arr)
+
+    # -- state versioning --------------------------------------------------
+
+    @property
+    def epoch(self) -> np.ndarray:
+        """Per-partition flush epochs (monotone; read-only view)."""
+        return self._epoch
+
+    @property
+    def epoch_key(self) -> bytes:
+        """Opaque identity of the flushed device state: equal keys mean no
+        flush touched any partition in between. The merge cache keys on it
+        and the serving plane uses it as the snapshot-dedupe source key."""
+        return self._epoch.tobytes()
+
+    def _bump_epoch(self, which) -> None:
+        """Advance the epoch of every partition in ``which`` (index list or
+        boolean mask) — called by each flush path for the partitions whose
+        merged state is about to change."""
+        self._epoch[which] += 1
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self._counters is not None:
+            self._counters.inc(name, n)
 
     # -- ingest -----------------------------------------------------------
 
@@ -441,6 +496,7 @@ class PartitionSet:
         if total == 0:
             return
         t0 = time.perf_counter_ns()
+        self._bump_epoch(self._pending_rows > 0)
         with self.tracer.phase("flush/assemble"):
             rows = self._drain_pending()
 
@@ -449,9 +505,25 @@ class PartitionSet:
         # pending rows (heavy skew) take extra rounds
         B = _next_pow2(min(max_rows, max(self.buffer_size, _MIN_CAP)))
         n_rounds = -(-max_rows // B)
-        for rnd in range(n_rounds):
+        # staged pipeline: round r+1..r+depth are assembled and device_put
+        # AFTER round r's merge kernel is dispatched (async), so host-side
+        # assembly and the upload overlap the in-flight kernel — and a
+        # growth sync at round r+1 waits behind an upload that's already
+        # moving instead of serializing in front of it
+        depth = flush_stage_depth()
+        staged: dict[int, tuple] = {}
+
+        def _stage(r: int):
             with self.tracer.phase("flush/assemble"):
-                batch, bvalid, widths = self._round_batch(rows, rnd, B)
+                batch, bvalid, widths = self._round_batch(rows, r, B)
+            with self.tracer.phase("flush/device_put"):
+                return self._put(batch), self._put(bvalid), widths
+
+        for rnd in range(n_rounds):
+            if rnd not in staged:
+                staged[rnd] = _stage(rnd)
+            batch_dev, bvalid_dev, widths = staged.pop(rnd)
+
             def _grow_bucket():
                 return _next_pow2(max(int((self._count_ub + widths).max()), 1))
 
@@ -463,9 +535,6 @@ class PartitionSet:
                 self._count_ub = np.asarray(self._count_dev, dtype=np.int64)
                 grow = _grow_bucket()
             out_cap = max(self._cap, grow)
-            with self.tracer.phase("flush/device_put"):
-                batch_dev = self._put(batch)
-                bvalid_dev = self._put(bvalid)
             with self.tracer.phase("flush/merge_kernel"):
                 if self.mesh is not None:
                     # explicit SPMD: pallas_call has no GSPMD partitioning
@@ -506,6 +575,9 @@ class PartitionSet:
                     np.asarray(self._count_dev)
             self._cap = out_cap
             self._count_ub = np.minimum(out_cap, self._count_ub + widths)
+            for s in range(rnd + 1, min(rnd + 1 + depth, n_rounds)):
+                if s not in staged:
+                    staged[s] = _stage(s)
         self._counts_cache = None
         self._host_cache = None
         self.processing_ns += time.perf_counter_ns() - t0
@@ -525,9 +597,23 @@ class PartitionSet:
         # the device already drained while later rounds queued — keeps the
         # active bucket near the true size without stalling the pipeline
         prev: list[tuple] = []  # (counts_dev_after_round, widths_of_round)
-        for rnd in range(n_rounds):
+        # same staged assemble/upload pipeline as the incremental rounds
+        # (see flush_all): the next rounds' host work overlaps this round's
+        # kernel, and a capacity-growth sync waits behind uploads that are
+        # already in flight instead of serializing ahead of them
+        depth = flush_stage_depth()
+        staged: dict[int, tuple] = {}
+
+        def _stage(r: int):
             with self.tracer.phase("flush/assemble"):
-                batch, bvalid, widths = self._round_batch(rows, rnd, B)
+                batch, bvalid, widths = self._round_batch(rows, r, B)
+            with self.tracer.phase("flush/device_put"):
+                return self._put(batch), self._put(bvalid), widths
+
+        for rnd in range(n_rounds):
+            if rnd not in staged:
+                staged[rnd] = _stage(rnd)
+            batch_dev, bvalid_dev, widths = staged.pop(rnd)
             if len(prev) >= 2:
                 c2, w1 = prev[-2][0], prev[-1][1]
                 self._count_ub = np.minimum(
@@ -545,9 +631,6 @@ class PartitionSet:
             active = min(
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
-            with self.tracer.phase("flush/device_put"):
-                batch_dev = self._put(batch)
-                bvalid_dev = self._put(bvalid)
             with self.tracer.phase("flush/merge_kernel"):
                 if self.mesh is not None:
                     rnd_fn = meshed_sfs_round(
@@ -564,6 +647,9 @@ class PartitionSet:
                     np.asarray(counts)
             prev.append((counts, widths))
             self._count_ub = np.minimum(self._cap, self._count_ub + widths)
+            for s in range(rnd + 1, min(rnd + 1 + depth, n_rounds)):
+                if s not in staged:
+                    staged[s] = _stage(s)
         self._count_dev = counts
         return counts
 
@@ -790,6 +876,7 @@ class PartitionSet:
         per round for balanced loads, per-partition rounds under routing
         skew. See stream/window.py's SFS notes for the invariant."""
         t0 = time.perf_counter_ns()
+        self._bump_epoch(self._pending_rows > 0)
         with self.tracer.phase("flush/assemble"):
             rows = self._drain_pending()
             for p, r in enumerate(rows):
@@ -878,11 +965,16 @@ class PartitionSet:
         self._counts_cache = None
         self._host_cache = None
         if tighten:
-            # tighten the upper bounds with ONE sync: the caller's next
-            # step is almost always the global merge, whose active bucket
-            # comes from _count_ub — loose row-count bounds (vs true
-            # survivor counts) can double its pairwise work for nothing
-            self.sky_counts()
+            # start the count transfer now but don't block on it: the
+            # caller's next step is almost always the global merge, whose
+            # active bucket comes from _count_ub — the first consumer
+            # (sky_counts / global_merge_stats) absorbs the already-landed
+            # bytes instead of stalling ingest here on a cold sync
+            try:
+                counts.copy_to_host_async()
+            except AttributeError:
+                pass
+            self._tighten_pending = True
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _flush_sweep(self) -> None:
@@ -900,6 +992,14 @@ class PartitionSet:
         d == 1 rides as (x, 0) pairs: constant second dim makes 2D
         dominance degenerate to 1D (strictness must come from x)."""
         t0 = time.perf_counter_ns()
+        # dirty set without a sync: host pending rows are known per
+        # partition; a non-empty device window could touch any partition,
+        # so it conservatively dirties all (over-bumping only costs cache
+        # reuse, never correctness)
+        if self._dev_rows > 0:
+            self._bump_epoch(slice(None))
+        else:
+            self._bump_epoch(self._pending_rows > 0)
         from skyline_tpu.ops.sweep2d import (
             partitioned_sweep2_core,
             scatter_sweep2,
@@ -1012,6 +1112,7 @@ class PartitionSet:
                 dw.SORT_TAIL,
             )
             bounds = np.asarray(bounds_dev, dtype=np.int64)
+        self._bump_epoch(np.diff(bounds) > 0)
         self._dev_rows = 0
         if tighten:
             had_old, old_counts = self._check_had_old()
@@ -1124,27 +1225,105 @@ class PartitionSet:
         (plus one bounded transfer when ``emit_points``) — replacing the
         full-buffer snapshot pull + host merge + re-upload. Single-device
         only (the engine falls back to the host path under a mesh).
+
+        Incremental reuse (``SKYLINE_MERGE_CACHE``, default on): the result
+        is cached keyed by the partition epoch vector. An identical key
+        means no flush touched any partition since the cached merge, so the
+        cached stats (and lazily-transferred points) come back with ZERO
+        kernel launches; when only a dirty subset changed (fraction <=
+        ``SKYLINE_DELTA_CUTOFF``) the merge runs over ``cached_global ∪
+        dirty skylines`` instead of the full union
+        (``global_merge_delta_device`` documents the correctness argument).
+        Either way the result is byte-identical to the from-scratch
+        recompute — tests/test_merge_cache.py property-checks this against
+        random flush/query interleavings.
         """
-        # the count upper bounds are maintained without syncs, so these
-        # buckets cost no round trip (pessimistic is safe: rows between
-        # count and active are invalid by the mask; union_cap from the
-        # SUMMED bounds keeps the pass union-sized under routing skew)
-        active = min(
-            self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
-        )
-        # quarter-pow2 ladder on the union too: the triangular pass costs
-        # O(union_cap^2), so the ladder's ~1.14x tighter bucket is ~1.3x
-        # less pairwise work at the north-star union (~437k rows)
-        union_cap = _active_bucket(max(int(self._count_ub.sum()), 1))
-        union, keep, stats = global_merge_stats_device(
-            self.sky, self._count_dev, active, union_cap
-        )
+        if self._tighten_pending:
+            # absorb the flush's async count transfer before sizing any
+            # bucket below: the bytes are already in flight, so this sync
+            # is cheap and the bounds it tightens halve the pairwise work
+            self.sky_counts()
+        use_cache = merge_cache_enabled() and self.mesh is None
+        cache = self._gm_cache if use_cache else None
+        key = self.epoch_key
+        if cache is not None and cache["key"] == key:
+            # exact hit: no flush touched any partition since this result
+            # was computed — return it without touching the device
+            self.merge_cache_hits += 1
+            self._inc("merge.cache_hit")
+            self._counts_cache = cache["counts"].copy()
+            self._count_ub = cache["counts"].copy()
+            return (
+                cache["counts"].copy(),
+                cache["surv"].copy(),
+                cache["g"],
+                self._cached_points() if emit_points else None,
+            )
+        self.merge_cache_misses += 1
+        self._inc("merge.cache_miss")
+        P = self.num_partitions
+        dirty = clean_total = None
+        if cache is not None:
+            dirty_mask = self._epoch != cache["epoch"]
+            self.last_dirty_fraction = float(dirty_mask.sum()) / P
+            cutoff = delta_dirty_cutoff()
+            if 0.0 < self.last_dirty_fraction <= cutoff:
+                dirty = dirty_mask
+        elif use_cache:
+            self.last_dirty_fraction = 1.0  # cold miss == everything dirty
+        if dirty is not None:
+            union, keep, stats, union_cap, clean_total = self._merge_delta(
+                cache, dirty
+            )
+        else:
+            # the count upper bounds are maintained without syncs, so these
+            # buckets cost no round trip (pessimistic is safe: rows between
+            # count and active are invalid by the mask; union_cap from the
+            # SUMMED bounds keeps the pass union-sized under routing skew)
+            active = min(
+                self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
+            )
+            # quarter-pow2 ladder on the union too: the triangular pass
+            # costs O(union_cap^2), so the ladder's ~1.14x tighter bucket is
+            # ~1.3x less pairwise work at the north-star union (~437k rows)
+            union_cap = _active_bucket(max(int(self._count_ub.sum()), 1))
+            union, keep, stats = global_merge_stats_device(
+                self.sky, self._count_dev, active, union_cap
+            )
+        # start the stats transfer before any host-side bookkeeping so the
+        # copy overlaps it instead of starting cold inside np.asarray
+        try:
+            stats.copy_to_host_async()
+        except AttributeError:
+            pass
         with self.tracer.phase("query/global_stats_sync"):
             svec = np.asarray(stats, dtype=np.int64)
-        P = self.num_partitions
         counts, surv, g = svec[:P].copy(), svec[P : 2 * P].copy(), int(svec[2 * P])
+        if dirty is not None:
+            self.merge_delta_merges += 1
+            drows = clean_total + int(counts[dirty].sum())
+            self.merge_delta_rows += drows
+            self._inc("merge.delta_rows", drows)
         pts = None
-        if emit_points:
+        if use_cache:
+            # compact the survivors into the cache buffer even when the
+            # caller skipped points: the next delta merge reads them, and a
+            # later emit_points hit transfers lazily. Capacity 2*pow2(g)
+            # keeps the delta kernel's clean dynamic_slice from ever
+            # clamping (lo <= g, clean_active <= pow2(g)).
+            gcap = 2 * _next_pow2(max(g, 1))
+            self._gm_cache = {
+                "key": key,
+                "epoch": self._epoch.copy(),
+                "counts": counts.copy(),
+                "surv": surv.copy(),
+                "g": g,
+                "pts_dev": global_points_device(union, keep, gcap),
+                "pts_host": None,
+            }
+            if emit_points:
+                pts = self._cached_points()
+        elif emit_points:
             out_cap = _next_pow2(max(g, 1))
             with self.tracer.phase("query/points_transfer"):
                 pts = np.asarray(
@@ -1154,6 +1333,47 @@ class PartitionSet:
         self._count_ub = counts.copy()
         return counts, surv, g, pts
 
+    def _merge_delta(self, cache, dirty: np.ndarray):
+        """Launch the dirty-subset merge (``global_merge_delta_device``)
+        against the cached global points. Returns ``(union, keep, stats,
+        union_cap, clean_total)`` — stats packs the CURRENT per-partition
+        counts, so the caller's sync/points path is shared with the full
+        merge. ``clean_bounds`` rides as a DEVICE array: survivor-layout
+        changes between merges then never recompile; only the (recurring)
+        dirty pattern and the size buckets are executable keys."""
+        surv = cache["surv"]
+        bounds = np.concatenate(([0], np.cumsum(surv))).astype(np.int32)
+        seg = np.where(dirty, 0, surv)
+        clean_total = int(seg.sum())
+        clean_active = _active_bucket(max(int(seg.max()), 1))
+        active = min(
+            self._cap,
+            _active_bucket(max(int(self._count_ub[dirty].max()), 1)),
+        )
+        union_cap = _active_bucket(
+            max(clean_total + int(self._count_ub[dirty].sum()), 1)
+        )
+        union, keep, stats = global_merge_delta_device(
+            self.sky,
+            self._count_dev,
+            cache["pts_dev"],
+            jnp.asarray(bounds),
+            active,
+            clean_active,
+            union_cap,
+            tuple(bool(b) for b in dirty),
+        )
+        return union, keep, stats, union_cap, clean_total
+
+    def _cached_points(self) -> np.ndarray:
+        """Host copy of the cached global skyline points, transferred at
+        most once per cached merge (later hits reuse the host array)."""
+        c = self._gm_cache
+        if c["pts_host"] is None:
+            with self.tracer.phase("query/points_transfer"):
+                c["pts_host"] = np.asarray(c["pts_dev"])[: c["g"]].copy()
+        return c["pts_host"].copy()
+
     def sky_counts(self) -> np.ndarray:
         """Exact survivor counts (P,) — one device sync (cached until the
         next flush)."""
@@ -1161,6 +1381,7 @@ class PartitionSet:
             with self.tracer.phase("query/count_sync"):
                 self._counts_cache = np.asarray(self._count_dev, dtype=np.int64)
             self._count_ub = self._counts_cache.copy()
+        self._tighten_pending = False
         return self._counts_cache
 
     def _host_sky(self) -> np.ndarray:
@@ -1235,6 +1456,11 @@ class PartitionSet:
         self._cap = cap
         self._counts_cache = None
         self._host_cache = None
+        # restored state is a different world: advance every epoch so any
+        # merge cached against the pre-restore state can never be reused
+        self._epoch += 1
+        self._gm_cache = None
+        self._tighten_pending = False
         for p, pending in enumerate(pendings):
             if pending.shape[0]:
                 self._pending[p] = [pending]

@@ -200,6 +200,7 @@ class SkylineEngine:
             route=(config.algo, config.domain_max) if use_device else None,
             overlap_rows=config.overlap_rows,
             window_capacity=config.window_capacity,
+            counters=telemetry.counters if telemetry is not None else None,
         )
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
@@ -490,10 +491,13 @@ class SkylineEngine:
             partial_missing=partial_missing,
         )
 
-    def _publish_snapshot(self, points, q: _QueryState) -> None:
+    def _publish_snapshot(self, points, q: _QueryState, source_key=None) -> None:
         """Publish a completed global skyline, stamped with the query's
-        trace id and wrapped in a "publish" span when telemetry is on."""
-        meta = {"query_id": q.qid}
+        trace id and wrapped in a "publish" span when telemetry is on.
+        ``source_key``: opaque identity of the engine state the points came
+        from (the partition-epoch key) — the store dedupes repeat publishes
+        of an unchanged state instead of minting a new version."""
+        meta = {"query_id": q.qid, "source_key": source_key}
         if q.trace_id is not None:
             meta["trace_id"] = q.trace_id
         if self.telemetry is None:
@@ -592,7 +596,11 @@ class SkylineEngine:
             )
             tel.histogram("global_merge_ms").observe(merge_ms)
         if self.snapshots is not None:
-            self._publish_snapshot(pts, q)
+            # the epoch key identifies the flushed state the merge saw, so
+            # repeated triggers over unchanged state dedupe in the store
+            # (the host _finalize path publishes un-keyed: its unions mix
+            # per-partition arrival times, so no single key describes them)
+            self._publish_snapshot(pts, q, source_key=self.pset.epoch_key)
 
         starts = [s for s in self.pset.start_time_ms if s is not None]
         map_finish = now_ms + flush_wall_ms
@@ -674,6 +682,13 @@ class SkylineEngine:
                 "max_seen_id": self.pset.max_seen_id.tolist(),
             },
             "meshed": self.mesh is not None,
+            "merge_cache": {
+                "hits": self.pset.merge_cache_hits,
+                "misses": self.pset.merge_cache_misses,
+                "delta_merges": self.pset.merge_delta_merges,
+                "delta_rows": self.pset.merge_delta_rows,
+                "last_dirty_fraction": self.pset.last_dirty_fraction,
+            },
         }
         if include_skyline_counts:
             out["partitions"]["skyline_counts"] = (

@@ -482,6 +482,10 @@ class SlidingEngine:
             self.snapshots.publish(
                 global_sky,
                 query_id=q.qid,
+                # window identity: unchanged (records_in, slides_closed)
+                # means the recompute is byte-identical, so the store can
+                # dedupe repeat publishes instead of minting a version
+                source_key=(self.records_in, self._slides_closed),
                 slides_closed=self._slides_closed,
                 window_filled=self._slides_closed >= self.k,
                 **meta,

@@ -155,7 +155,11 @@ _merge_step_pallas_batched = jax.jit(
 )
 
 
-@functools.partial(jax.jit, static_argnames=("active", "out_active"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("active", "out_active"),
+    donate_argnums=(0, 1),
+)
 def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: int):
     """Incremental flush step over the ACTIVE capacity prefix only.
 
@@ -170,6 +174,14 @@ def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: in
     out_active >= per-partition count + batch rows (the caller's capacity
     bookkeeping guarantees both). Single-device only (the meshed path keeps
     ``meshed_merge_step``).
+
+    The stacked sky/valid buffers are donated (the ops/sfs.py idiom): the
+    steady-state same-shape flush updates in place instead of allocating a
+    fresh (P, cap, d) buffer per round, which is what lets the staged
+    pipeline keep two rounds in flight without doubling residency. Growth
+    rounds (out_cap > cap) can't reuse the buffer and fall back to a fresh
+    allocation with jax's "donated buffers not usable" warning (filtered in
+    tests/conftest.py, log-bounded in production by the doubling schedule).
     """
     from skyline_tpu.ops.dispatch import on_tpu
 
@@ -254,6 +266,97 @@ def global_points_device(union, keep, out_cap: int):
     for a single bounded transfer — only paid when a query asks for
     skyline_points."""
     return compact(union, keep, out_cap)[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("active", "clean_active", "union_cap", "dirty"),
+)
+def global_merge_delta_device(
+    sky,
+    counts,
+    gpts,
+    clean_bounds,
+    active: int,
+    clean_active: int,
+    union_cap: int,
+    dirty: tuple,
+):
+    """Dirty-subset variant of ``global_merge_stats_device``: the union is
+    ``cached_global ∪ dirty partitions' current skylines`` instead of every
+    partition's full prefix, shrinking the triangular pass from
+    O((Σ all counts)²) to O((g + Σ dirty)²).
+
+    Correctness (the merge law + transitivity): a CLEAN partition's
+    contribution is its cached global survivors — any of its points culled
+    at cache time had a dominator in some partition's then-skyline, and
+    partition skylines only lose points to strict dominance by current
+    members, so a current dominator always exists transitively; a DIRTY
+    partition contributes its full current skyline (its cached survivors
+    may be stale, so they are excluded — also what prevents a stale
+    duplicate from double-counting against the current copy). Survivor
+    order is byte-identical to the full merge: partitions are written in
+    ascending id, clean segments keep the cached (storage-order) layout,
+    and ``compact``'s stable sort preserves write order.
+
+    ``dirty``: static per-partition bool tuple (executable count is bounded
+    by the recurring dirty patterns; the caller's dirty-fraction cutoff
+    keeps the tail from compiling). ``clean_bounds``: (P+1,) int32 row
+    offsets of each partition's segment inside ``gpts`` (cumsum of the
+    cached per-partition survivor counts — dirty partitions' segments are
+    simply skipped). ``active`` bounds the dirty slices (bucket of the max
+    dirty count); ``clean_active`` bounds the clean slices (bucket of the
+    max clean segment width) — both slices write their full static width at
+    the running offset and advance by the true width, each garbage tail
+    overwritten by the next write (the gather trick
+    ``global_merge_stats_device`` documents). ``gpts`` capacity must be >=
+    g + clean_active so the clean ``dynamic_slice`` never clamps backward
+    (the caller pads the cached points buffer to 2*next_pow2(g)).
+
+    Returns (union, keep, stats) with the same shapes/semantics as the full
+    merge so the caller's sync/points paths are shared."""
+    from skyline_tpu.ops.dispatch import skyline_mask_auto
+
+    P, cap, d = sky.shape
+    scratch = union_cap + max(active, clean_active)
+    u = jnp.full((scratch, d), jnp.inf, dtype=sky.dtype)
+    uo = jnp.zeros((scratch,), dtype=jnp.int32)
+    off = jnp.zeros((), jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    for p in range(P):  # static unroll; P is small
+        if dirty[p]:
+            sl = lax.slice(sky, (p, 0, 0), (p + 1, active, d)).reshape(
+                active, d
+            )
+            u = lax.dynamic_update_slice(u, sl, (off, zero))
+            uo = lax.dynamic_update_slice(
+                uo, jnp.full((active,), p, jnp.int32), (off,)
+            )
+            off = off + counts[p].astype(jnp.int32)
+        else:
+            lo = clean_bounds[p]
+            w = clean_bounds[p + 1] - lo
+            sl = lax.dynamic_slice(gpts, (lo, zero), (clean_active, d))
+            # unlike ``sky`` prefixes, rows past this segment are NOT +inf
+            # padding — they are the NEXT partitions' cached survivors — so
+            # the static-width tail must be masked out before the write (a
+            # shorter next write would otherwise leave live duplicates)
+            sl = jnp.where(
+                jnp.arange(clean_active)[:, None] < w, sl, jnp.inf
+            )
+            u = lax.dynamic_update_slice(u, sl, (off, zero))
+            uo = lax.dynamic_update_slice(
+                uo, jnp.full((clean_active,), p, jnp.int32), (off,)
+            )
+            off = off + w
+    u = lax.slice(u, (0, 0), (union_cap, d))
+    uo = lax.slice(uo, (0,), (union_cap,))
+    uv = jnp.arange(union_cap) < off
+    keep = skyline_mask_auto(u, uv)
+    surv = jax.ops.segment_sum(keep.astype(jnp.int32), uo, num_segments=P)
+    g = keep.sum(dtype=jnp.int32)
+    stats = jnp.concatenate([counts.astype(jnp.int32), surv, g[None]])
+    return u, keep, stats
 
 
 def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):

@@ -47,6 +47,12 @@ class Telemetry:
         self._hists: dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
+    def inc(self, name: str, n: int = 1) -> None:
+        """Bump a named monotonic counter (shorthand for
+        ``self.counters.inc`` so call sites holding only the hub don't
+        reach through it)."""
+        self.counters.inc(name, n)
+
     def histogram(self, name: str, unit: str = "ms") -> Histogram:
         h = self._hists.get(name)
         if h is None:

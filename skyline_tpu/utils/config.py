@@ -62,6 +62,7 @@ class JobConfig:
     serve_query_deadline_ms: float = 10_000.0
     serve_delta_ring: int = 128  # retained snapshot transitions
     serve_history: int = 64  # retained snapshot versions
+    serve_read_cache: int = 64  # serialized-response LRU entries (0 = off)
     # observability (skyline_tpu/telemetry): Chrome trace-event export of
     # the per-query span ring, and opt-in device profiling of forced merges
     trace_out: str = ""  # write span ring as Chrome trace JSON on close
@@ -200,6 +201,7 @@ class JobConfig:
             query_deadline_ms=self.serve_query_deadline_ms,
             delta_ring=self.serve_delta_ring,
             history=self.serve_history,
+            read_cache_entries=self.serve_read_cache,
         )
 
     def build_mesh(self):
@@ -327,6 +329,10 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=_env_int("SERVE_HISTORY",
                                      defaults.serve_history),
                     help="snapshot versions retained in the store")
+    ap.add_argument("--serve-read-cache", type=int,
+                    default=_env_int("SERVE_READ_CACHE",
+                                     defaults.serve_read_cache),
+                    help="serialized-response LRU entries (0 disables)")
     ap.add_argument("--trace-out",
                     default=os.environ.get("SKYLINE_TRACE_OUT",
                                            defaults.trace_out),
@@ -374,6 +380,7 @@ def parse_job_args(argv=None) -> JobConfig:
         serve_query_deadline_ms=a.serve_query_deadline_ms,
         serve_delta_ring=a.serve_delta_ring,
         serve_history=a.serve_history,
+        serve_read_cache=a.serve_read_cache,
         trace_out=a.trace_out,
         trace_ring=a.trace_ring,
         jax_profile_dir=a.jax_profile_dir,

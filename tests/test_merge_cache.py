@@ -1,0 +1,224 @@
+"""Incremental global-merge cache (ISSUE 3 tentpole) semantics.
+
+The epoch-keyed cache must be an invisible optimization: every
+``global_merge_stats`` result — exact hit, dirty-subset delta merge, or
+full recompute — must be byte-identical to what a cache-off PartitionSet
+computes from the same state. These tests pin
+
+* the zero-kernel acceptance criterion: a repeated trigger with no
+  intervening flush answers from the cache (``merge.cache_hit`` counter),
+* the randomized equivalence property over flush/query interleavings
+  across uniform/correlated/anti-correlated workloads and d in {2, 4, 8}
+  (d=2 routes through the sweep flush path, whose epoch bump differs),
+* the delta path's counters/cutoff knob, and
+* the ride-along serving pieces: snapshot publish dedupe by source_key
+  and the serve-side read LRU.
+"""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.metrics.collector import Counters
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.workload.generators import anti_correlated, correlated, uniform
+
+
+def _fill(pset, rng, x, P, max_id=0):
+    pids = rng.integers(0, P, x.shape[0])
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=max_id, now_ms=0.0)
+    pset.flush_all()
+
+
+def _merge(pset):
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    return np.asarray(counts), np.asarray(surv), int(g), np.asarray(pts)
+
+
+def test_repeated_trigger_is_pure_cache_hit(rng):
+    """ISSUE acceptance: repeated query trigger with no intervening flush
+    launches zero merge kernels, observed via the merge.cache_hit
+    counter."""
+    counters = Counters()
+    ps = PartitionSet(4, 4, buffer_size=256, counters=counters)
+    _fill(ps, rng, uniform(rng, 2000, 4, 0, 10000).astype(np.float32), 4)
+
+    c1, s1, g1, p1 = _merge(ps)
+    assert counters.get("merge.cache_hit") == 0
+    assert counters.get("merge.cache_miss") == 1
+
+    c2, s2, g2, p2 = _merge(ps)
+    assert counters.get("merge.cache_hit") == 1, "second trigger must not merge"
+    assert ps.merge_cache_hits == 1 and ps.merge_cache_misses == 1
+    assert g2 == g1 and p2.tobytes() == p1.tobytes()
+    np.testing.assert_array_equal(c2, c1)
+    np.testing.assert_array_equal(s2, s1)
+
+    # cached results are copies: callers mutating them must not poison
+    # subsequent reads
+    p2[:] = -1.0
+    c2[:] = -1
+    _, _, g3, p3 = _merge(ps)
+    assert g3 == g1 and p3.tobytes() == p1.tobytes()
+    assert counters.get("merge.cache_hit") == 2
+
+
+def test_flush_invalidates_and_delta_merges(rng, monkeypatch):
+    """Dirtying one partition of eight takes the delta path (fraction
+    0.125 <= cutoff) and matches the cache-off full recompute."""
+    P = 8
+    ps = PartitionSet(P, 4, buffer_size=512)
+    ref = PartitionSet(P, 4, buffer_size=512)
+    monkeypatch.delenv("SKYLINE_MERGE_CACHE", raising=False)
+    x = anti_correlated(rng, 4000, 4, 0, 10000).astype(np.float32)
+    r2 = np.random.default_rng(0)
+    _fill(ps, r2, x, P)
+    r2 = np.random.default_rng(0)
+    _fill(ref, r2, x, P)
+    _merge(ps)  # prime the cache
+
+    top = uniform(rng, 64, 4, 0, 10000).astype(np.float32)
+    for t in (ps, ref):
+        t.add_batch(0, top, max_id=1, now_ms=0.0)
+        t.flush_all()
+
+    res = _merge(ps)
+    assert ps.merge_delta_merges == 1
+    assert ps.last_dirty_fraction == pytest.approx(1 / P)
+    assert ps.merge_delta_rows > 0
+
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    want = _merge(ref)
+    assert res[2] == want[2]
+    assert res[3].tobytes() == want[3].tobytes()
+    np.testing.assert_array_equal(res[0], want[0])
+    np.testing.assert_array_equal(res[1], want[1])
+
+
+def test_delta_cutoff_zero_disables_delta_path(rng, monkeypatch):
+    """SKYLINE_DELTA_CUTOFF=0 keeps the exact-hit cache but forces full
+    merges for any dirty state."""
+    monkeypatch.setenv("SKYLINE_DELTA_CUTOFF", "0")
+    ps = PartitionSet(4, 3, buffer_size=256)
+    _fill(ps, rng, uniform(rng, 1000, 3, 0, 10000).astype(np.float32), 4)
+    _merge(ps)
+    ps.add_batch(0, uniform(rng, 16, 3, 0, 10000).astype(np.float32),
+                 max_id=1, now_ms=0.0)
+    ps.flush_all()
+    _merge(ps)
+    assert ps.merge_delta_merges == 0
+    assert ps.merge_cache_misses == 2
+    _merge(ps)
+    assert ps.merge_cache_hits == 1  # exact-hit reuse still works
+
+
+@pytest.mark.parametrize("gen", [uniform, correlated, anti_correlated],
+                         ids=["uniform", "correlated", "anti_correlated"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_equivalence_random_interleaving(gen, d, monkeypatch):
+    """Property: under random flush/query interleavings the cached engine's
+    every answer is byte-identical to a cache-off twin fed the same
+    batches (counts, survivors, global count, and point bytes)."""
+    P = 4
+    rng = np.random.default_rng(d * 101 + len(gen.__name__))
+    cached = PartitionSet(P, d, buffer_size=256)
+    plain = PartitionSet(P, d, buffer_size=256)
+
+    def trigger_both():
+        monkeypatch.setenv("SKYLINE_MERGE_CACHE", "1")
+        a = _merge(cached)
+        monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+        b = _merge(plain)
+        assert a[2] == b[2], "global count diverged"
+        assert a[3].tobytes() == b[3].tobytes(), "points diverged"
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    # seed both with identically-routed batches, then interleave
+    x0 = gen(rng, 1200, d, 0, 10000).astype(np.float32)
+    pids0 = rng.integers(0, P, x0.shape[0])
+    for t in (cached, plain):
+        for p in range(P):
+            rows = np.ascontiguousarray(x0[pids0 == p])
+            if rows.shape[0]:
+                t.add_batch(p, rows, max_id=0, now_ms=0.0)
+        t.flush_all()
+    for step in range(10):
+        op = rng.integers(0, 3)
+        if step == 0 or op == 0:
+            # dirty a random non-empty subset of partitions
+            k = int(rng.integers(1, P + 1))
+            parts = rng.choice(P, size=k, replace=False)
+            for p in parts:
+                rows = gen(rng, int(rng.integers(1, 400)), d, 0, 10000)
+                rows = rows.astype(np.float32)
+                for t in (cached, plain):
+                    t.add_batch(int(p), rows.copy(), max_id=step, now_ms=0.0)
+            for t in (cached, plain):
+                t.flush_all()
+            trigger_both()
+        elif op == 1:
+            trigger_both()  # repeated trigger: exact-hit path
+        else:
+            # flush with no new rows then trigger (epoch must not churn
+            # into spurious misses, and must not miss real changes)
+            for t in (cached, plain):
+                t.flush_all()
+            trigger_both()
+
+
+def test_equivalence_with_staging_disabled(rng, monkeypatch):
+    """SKYLINE_STAGE_DEPTH=0 (synchronous flushes) must not change any
+    merged bytes."""
+    monkeypatch.setenv("SKYLINE_STAGE_DEPTH", "0")
+    P, d = 4, 4
+    cached = PartitionSet(P, d, buffer_size=256)
+    plain = PartitionSet(P, d, buffer_size=256)
+    x = anti_correlated(rng, 3000, d, 0, 10000).astype(np.float32)
+    for t, seed in ((cached, 3), (plain, 3)):
+        _fill(t, np.random.default_rng(seed), x, P)
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "1")
+    a = _merge(cached)
+    a2 = _merge(cached)  # hit
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    b = _merge(plain)
+    assert a[3].tobytes() == b[3].tobytes() == a2[3].tobytes()
+    assert cached.merge_cache_hits == 1
+
+
+def test_restore_drops_cache(rng):
+    """restore_all must invalidate: a stale cached global would resurrect
+    pre-restore state."""
+    ps = PartitionSet(2, 3, buffer_size=128)
+    x = uniform(rng, 500, 3, 0, 10000).astype(np.float32)
+    _fill(ps, rng, x, 2)
+    _merge(ps)
+    skies = [ps.skyline_host(p) for p in range(2)]
+    pendings = [ps.pending_rows_of(p) for p in range(2)]
+    y = uniform(rng, 500, 3, 0, 10000).astype(np.float32) + 20000
+    ps.restore_all(skies, pendings)  # epoch bumped, cache dropped
+    before = _merge(ps)
+    ps.add_batch(0, y, max_id=1, now_ms=0.0)
+    ps.flush_all()
+    after = _merge(ps)
+    assert ps.merge_cache_hits == 0  # every post-restore state was new
+    assert before[2] >= 1 and after[2] >= 1
+
+
+def test_snapshot_store_dedupes_by_source_key():
+    from skyline_tpu.serve.snapshot import SnapshotStore
+
+    store = SnapshotStore()
+    pts = np.arange(6, dtype=np.float32).reshape(3, 2)
+    s1 = store.publish(pts, watermark_id=0, source_key=b"k1")
+    s2 = store.publish(pts, watermark_id=1, source_key=b"k1")
+    assert s2 is s1 and s2.version == s1.version
+    assert store.stats()["deduped"] == 1
+    s3 = store.publish(pts, watermark_id=2, source_key=b"k2")
+    assert s3.version == s1.version + 1
+    # un-keyed publishes never dedupe
+    s4 = store.publish(pts, watermark_id=3)
+    assert s4.version == s3.version + 1
+    assert store.stats()["deduped"] == 1
