@@ -95,13 +95,15 @@ def run_window(cfg, ids, x, required, tracer=None):
     return dt, result
 
 
-def merge_cache_leg(cfg, ids, x, required) -> dict:
-    """Merge-cache truth for the bench artifact: ONE persistent engine,
-    trigger twice over an unchanged window (cold miss + exact hit), then a
-    small top-up and a third trigger (dirty-subset delta merge). Stamps
-    hit/miss/delta counters and the last dirty fraction as a
-    ``phase_breakdown_ms`` sibling so ``scripts/bench_compare.py`` can gate
-    on the cache staying live; the full/delta/hit latency A/B lives in
+def merge_cache_leg(cfg, ids, x, required) -> tuple[dict, dict]:
+    """Merge-cache + merge-tree truth for the bench artifact: ONE
+    persistent engine, trigger twice over an unchanged window (cold miss +
+    exact hit), then a small top-up and a third trigger (dirty-subset delta
+    merge). Stamps hit/miss/delta counters, the last dirty fraction, and
+    the tournament-tree shape (levels / partitions pruned / candidates per
+    level) as ``phase_breakdown_ms`` siblings so
+    ``scripts/bench_compare.py`` can gate on the cache AND the pruned tree
+    staying live; the full/delta/hit latency A/B lives in
     ``benchmarks/merge_cache.py``."""
     from skyline_tpu.stream import SkylineEngine
 
@@ -119,10 +121,11 @@ def merge_cache_leg(cfg, ids, x, required) -> dict:
     eng.process_records(ids[:m], np.repeat(x[:1], m, axis=0))
     eng.process_trigger(f"0,{required}")
     eng.poll_results()
-    mc = eng.stats()["merge_cache"]
+    st = eng.stats()
+    mc = st["merge_cache"]
     total = mc["hits"] + mc["misses"]
     mc["hit_rate"] = round(mc["hits"] / total, 3) if total else 0.0
-    return mc
+    return mc, st.get("merge_tree", {})
 
 
 def serve_leg(d: int, algo: str) -> dict:
@@ -345,11 +348,12 @@ def child_main(backend: str) -> None:
     else:
         serve = {"skipped": True}
     try:
-        merge_cache = merge_cache_leg(
+        merge_cache, merge_tree = merge_cache_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
         )
     except Exception as e:  # pragma: no cover - diagnostic path
         merge_cache = {"error": f"{type(e).__name__}: {e}"}
+        merge_tree = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -375,6 +379,7 @@ def child_main(backend: str) -> None:
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "merge_cache": merge_cache,
+                "merge_tree": merge_tree,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }
         )
@@ -464,10 +469,30 @@ def _attach_last_tpu_run(result: dict) -> None:
         pass
 
 
-def main() -> None:
-    from skyline_tpu.utils.backend_probe import probe_backend
+def _probe_stamp(probe: dict) -> dict:
+    """The probe fields worth persisting in every bench artifact —
+    including ``probe_total_s`` so time burned on a dead tunnel (timeouts +
+    backoff) is visible, not silently folded into bench wall time."""
+    return {
+        k: probe[k]
+        for k in (
+            "backend",
+            "n_devices",
+            "attempts",
+            "probe_s",
+            "probe_total_s",
+            "cached",
+        )
+        if k in probe
+    }
 
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+
+def main() -> None:
+    from skyline_tpu.utils.backend_probe import probe_backend, probe_timeout_s
+
+    # SKYLINE_PROBE_TIMEOUT_S is the canonical knob (shared with the doctor
+    # scripts); the legacy BENCH_PROBE_TIMEOUT still works underneath
+    probe_timeout = probe_timeout_s(150.0)
     probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2))
     probe_backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 20))
     child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 3000))
@@ -482,6 +507,9 @@ def main() -> None:
     errors: list[str] = []
     probe: dict = {}
     if not force_cpu:
+        # the verdict caches for the process lifetime (backend_probe), so a
+        # re-entrant orchestration (wrapper scripts calling main twice)
+        # pays the subprocess — or the dead-tunnel timeout — only once
         probe = probe_backend(probe_timeout, probe_attempts, probe_backoff)
         errors.extend(probe.get("errors", []))
 
@@ -491,11 +519,7 @@ def main() -> None:
         for i in range(tpu_attempts):
             result, err = run_child("tpu", child_timeout)
             if result is not None:
-                result["probe"] = {
-                    k: probe[k]
-                    for k in ("backend", "n_devices", "attempts", "probe_s")
-                    if k in probe
-                }
+                result["probe"] = _probe_stamp(probe)
                 if errors:
                     result["orchestrator_errors"] = errors
                 print(json.dumps(result))
@@ -510,6 +534,8 @@ def main() -> None:
     # CPU fallback: a reduced-size but real measurement beats no number
     result, err = run_child("cpu", child_timeout)
     if result is not None:
+        if probe:
+            result["probe"] = _probe_stamp(probe)
         result["orchestrator_errors"] = errors
         result["diagnosis"] = (
             "TPU unavailable; value measured on CPU fallback"
@@ -531,6 +557,8 @@ def main() -> None:
         "diagnosis": "benchmark failed on all backends",
         "orchestrator_errors": errors[-6:],
     }
+    if probe:
+        failure["probe"] = _probe_stamp(probe)
     _attach_last_tpu_run(failure)
     print(json.dumps(failure))
     sys.exit(0)  # the JSON line IS the result; don't mask it with rc!=0

@@ -15,6 +15,12 @@ recompute of the same state (the randomized interleaving property test
 lives in tests/test_merge_cache.py). Writes
 ``artifacts/merge_cache_ab.json``.
 
+A third leg A/Bs the ISSUE-4 pruned tournament-tree merge against the
+flat union pass (both cache-off full merges over identical state,
+byte-identity asserted; the ``full`` leg above pins
+``SKYLINE_MERGE_TREE=0`` so it stays the flat baseline). Writes
+``artifacts/merge_tree_ab.json``.
+
 Usage: python benchmarks/merge_cache.py [--repeats 5] [--sizes ...]
 """
 
@@ -55,12 +61,16 @@ def bench_one(n: int, d: int, P: int, repeats: int) -> dict:
             pset.add_batch(p, rows, max_id=n, now_ms=0.0)
     pset.flush_all()
 
-    # full: every trigger pays the whole union (the pre-cache behavior)
+    # full: every trigger pays the whole union (the pre-cache behavior);
+    # tree pinned OFF so this stays the flat baseline the other legs —
+    # and bench_tree below — compare against
     os.environ["SKYLINE_MERGE_CACHE"] = "0"
+    os.environ["SKYLINE_MERGE_TREE"] = "0"
     pset.global_merge_stats(emit_points=True)  # warm the executables
     full_ms = _timed(
         lambda: pset.global_merge_stats(emit_points=True), repeats
     )
+    os.environ.pop("SKYLINE_MERGE_TREE", None)
 
     # hit: primed cache, unchanged state — no kernel launches at all
     os.environ["SKYLINE_MERGE_CACHE"] = "1"
@@ -114,6 +124,53 @@ def bench_one(n: int, d: int, P: int, repeats: int) -> dict:
     }
 
 
+def bench_tree(n: int, d: int, P: int, repeats: int) -> dict:
+    """Tree-vs-flat full merge over identical state, both cache-off, with
+    the byte-identity assert the tree's pruning must uphold."""
+    from skyline_tpu.stream.batched import PartitionSet
+    from skyline_tpu.workload.generators import anti_correlated
+
+    os.environ["SKYLINE_MERGE_CACHE"] = "0"
+    rng = np.random.default_rng(1)
+    x = anti_correlated(rng, n, d, 0, 10000).astype(np.float32)
+    pids = rng.integers(0, P, n)
+    pset = PartitionSet(P, d, buffer_size=max(n, 1024))
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=n, now_ms=0.0)
+    pset.flush_all()
+
+    os.environ["SKYLINE_MERGE_TREE"] = "0"
+    flat_ref = pset.global_merge_stats(emit_points=True)  # warm
+    flat_ms = _timed(
+        lambda: pset.global_merge_stats(emit_points=True), repeats
+    )
+
+    os.environ["SKYLINE_MERGE_TREE"] = "1"
+    tree_res = pset.global_merge_stats(emit_points=True)  # warm
+    assert tree_res[2] == flat_ref[2], (tree_res[2], flat_ref[2])
+    assert tree_res[3].tobytes() == flat_ref[3].tobytes(), (
+        f"tree diverges from flat merge at n={n} d={d}"
+    )
+    tree_ms = _timed(
+        lambda: pset.global_merge_stats(emit_points=True), repeats
+    )
+    info = pset.last_tree_info or {}
+    return {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "skyline_size": int(flat_ref[2]),
+        "flat_full_ms": round(flat_ms, 2),
+        "tree_full_ms": round(tree_ms, 2),
+        "tree_speedup": round(flat_ms / tree_ms, 2) if tree_ms else None,
+        "levels": info.get("levels"),
+        "pruned_fraction": info.get("pruned_fraction"),
+        "candidates_per_level": info.get("candidates_per_level"),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--repeats", type=int, default=5)
@@ -121,6 +178,7 @@ def main(argv=None):
     ap.add_argument("--dims", type=int, nargs="+", default=[8])
     ap.add_argument("--partitions", type=int, default=8)
     ap.add_argument("--out", default="artifacts/merge_cache_ab.json")
+    ap.add_argument("--tree-out", default="artifacts/merge_tree_ab.json")
     a = ap.parse_args(argv)
 
     import jax
@@ -131,10 +189,18 @@ def main(argv=None):
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    prev = os.environ.get("SKYLINE_MERGE_CACHE")
+    prev = {
+        k: os.environ.get(k)
+        for k in ("SKYLINE_MERGE_CACHE", "SKYLINE_MERGE_TREE")
+    }
     results = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
+        "rows": [],
+    }
+    tree_results = {
+        "backend": results["backend"],
+        "device": results["device"],
         "rows": [],
     }
     try:
@@ -143,15 +209,23 @@ def main(argv=None):
                 row = bench_one(n, d, a.partitions, a.repeats)
                 print(json.dumps(row), flush=True)
                 results["rows"].append(row)
+                trow = bench_tree(n, d, a.partitions, a.repeats)
+                print(json.dumps(trow), flush=True)
+                tree_results["rows"].append(trow)
     finally:
-        if prev is None:
-            os.environ.pop("SKYLINE_MERGE_CACHE", None)
-        else:
-            os.environ["SKYLINE_MERGE_CACHE"] = prev
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     if a.out:
         os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
         with open(a.out, "w") as f:
             json.dump(results, f, indent=1)
+    if a.tree_out:
+        os.makedirs(os.path.dirname(a.tree_out) or ".", exist_ok=True)
+        with open(a.tree_out, "w") as f:
+            json.dump(tree_results, f, indent=1)
     return 0
 
 

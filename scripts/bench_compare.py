@@ -34,16 +34,48 @@ import json
 import os
 import sys
 
-# (label, path into parsed, higher_is_better)
+def _merge_kernel_share(parsed: dict) -> float | None:
+    """``flush/merge_kernel`` as a fraction of ``profile_window_total`` —
+    the slice of the profiled window the global-merge kernels burn. The
+    pruned tournament tree exists to shrink this; a share creep means the
+    tree (or its prefilter) went dead."""
+    phases = parsed.get("phase_breakdown_ms")
+    if not isinstance(phases, dict):
+        return None
+    num = phases.get("flush/merge_kernel")
+    den = phases.get("profile_window_total")
+    if (
+        not isinstance(num, (int, float))
+        or not isinstance(den, (int, float))
+        or isinstance(num, bool)
+        or isinstance(den, bool)
+        or den <= 0
+    ):
+        return None
+    return float(num) / float(den)
+
+
+# (label, path into parsed OR callable(parsed) -> float|None,
+#  higher_is_better, tpu_only)
 METRICS = (
-    ("value", ("value",), True),
-    ("p50_window_latency_ms", ("p50_window_latency_ms",), False),
-    ("serve.read_p50_ms", ("serve", "read_p50_ms"), False),
-    ("serve.read_p99_ms", ("serve", "read_p99_ms"), False),
+    ("value", ("value",), True, False),
+    ("p50_window_latency_ms", ("p50_window_latency_ms",), False, False),
+    ("serve.read_p50_ms", ("serve", "read_p50_ms"), False, False),
+    ("serve.read_p99_ms", ("serve", "read_p99_ms"), False, False),
     # merge-cache leg (bench.py merge_cache_leg): a hit-rate drop means the
     # epoch-keyed reuse went dead — absent/zero (older artifacts, leg
     # errored) skips, never fails
-    ("merge_cache.hit_rate", ("merge_cache", "hit_rate"), True),
+    ("merge_cache.hit_rate", ("merge_cache", "hit_rate"), True, False),
+    # tournament-tree leg: pruned_fraction dropping means the witness
+    # prefilter stopped dropping partitions (dead summaries / gating bug)
+    ("merge_tree.pruned_fraction", ("merge_tree", "pruned_fraction"),
+     True, False),
+    # merge-kernel share of the profiled window (computed, lower better):
+    # the headline the pruned tree + tile skip are accountable for. Only
+    # gated on real-TPU artifacts — on the cpu-fallback the phase mix is
+    # noise-dominated (the merge kernels cost a wholly different fraction
+    # of CPU wall), so a share swing there says nothing about the tree
+    ("flush/merge_kernel share", _merge_kernel_share, False, True),
 )
 
 
@@ -56,7 +88,9 @@ def load_parsed(path: str) -> dict:
     return parsed
 
 
-def dig(parsed: dict, path: tuple) -> float | None:
+def dig(parsed: dict, path) -> float | None:
+    if callable(path):
+        return path(parsed)
     cur = parsed
     for k in path:
         if not isinstance(cur, dict) or k not in cur:
@@ -71,7 +105,11 @@ def compare(old: dict, new: dict, threshold: float) -> tuple[list[str], bool]:
     """Return (report lines, any_regression)."""
     lines = []
     regressed = False
-    for label, path, higher_better in METRICS:
+    on_tpu = old.get("backend") == "tpu"
+    for label, path, higher_better, tpu_only in METRICS:
+        if tpu_only and not on_tpu:
+            lines.append(f"  {label:<24} skipped (tpu-only metric)")
+            continue
         a, b = dig(old, path), dig(new, path)
         if a is None or b is None or a == 0:
             lines.append(f"  {label:<24} skipped (absent or zero)")

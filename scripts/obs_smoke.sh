@@ -123,7 +123,11 @@ try:
     for want in ("skyline_ingest_batch_ms_bucket",
                  "skyline_query_latency_ms_count",
                  "skyline_merge_cache_hit_total",
-                 "skyline_merge_cache_miss_total"):
+                 "skyline_merge_cache_miss_total",
+                 # tournament-tree merge (dims 3 > 2, so the tree ran and
+                 # registered its series even if nothing got pruned)
+                 "skyline_merge_tree_levels_total",
+                 "skyline_merge_partitions_pruned_total"):
         assert want in body, f"{want} missing from exposition"
     with urllib.request.urlopen(f"{serve_base}/metrics", timeout=5) as r:
         serve_body = r.read().decode()
@@ -158,6 +162,39 @@ for want in ("ingest", "local", "merge", "publish"):
 print(f"[obs-smoke] --trace-out ok: {len(doc['traceEvents'])} events "
       f"at {trace_out} (load at https://ui.perfetto.dev)")
 print("[obs-smoke] PASS")
+EOF
+
+# pruned tournament-tree merge: the witness prefilter must not change a
+# single output byte — merge identical state with pruning on and off and
+# compare the emitted point buffers digest-for-digest
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.workload.generators import anti_correlated
+
+os.environ["SKYLINE_MERGE_CACHE"] = "0"
+os.environ["SKYLINE_MERGE_TREE"] = "1"
+digests = {}
+for prune in ("1", "0"):
+    os.environ["SKYLINE_MERGE_PRUNE"] = prune
+    rng = np.random.default_rng(23)
+    pset = PartitionSet(4, 3)
+    x = anti_correlated(rng, 4000, 3, 0, 10000).astype(np.float32)
+    pids = rng.integers(0, 4, len(x))
+    for p in range(4):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=len(x), now_ms=0.0)
+    pset.flush_all()
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    digests[prune] = (int(g), np.asarray(surv).tobytes(), pts.tobytes())
+assert digests["1"] == digests["0"], \
+    "prune on/off merge results diverge (g or point bytes differ)"
+print(f"[obs-smoke] prune digest ok: g={digests['1'][0]} identical "
+      "with SKYLINE_MERGE_PRUNE=1 and =0")
 EOF
 
 # regression gate: newest two artifacts must currently pass at default

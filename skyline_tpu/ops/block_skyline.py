@@ -138,25 +138,54 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
     rows = xp.reshape(nb, chunk, d)
     rvalid = vp.reshape(nb, chunk)
 
+    # Sum-bound chunk skip (same argument as pallas_dominance._tile_sum_skip:
+    # f32 addition is monotone, so a dominator's sum never exceeds its
+    # victim's). A chunk whose smallest valid-row sum beats every valid
+    # point's sum cannot dominate anything; lax.cond genuinely skips the
+    # (chunk, N) tile at runtime (the scan is not vmapped). All-padding
+    # chunks — capacity-bucket overshoot — always skip. Skipped chunks leave
+    # invalid positions undominated, which `& vp` masks identically.
+    sums = jnp.where(vp, jnp.sum(xp, axis=-1), jnp.inf)
+    chunk_min = jnp.min(sums.reshape(nb, chunk), axis=1)
+    victim_max = jnp.max(jnp.where(vp, sums, -jnp.inf))
+
     def step(dom, blk):
-        rx, rv = blk
-        dom = dom | dominated_by(xp, rx, x_valid=rv)
+        rx, rv, mn = blk
+        dom = lax.cond(
+            mn > victim_max,
+            lambda d: d,
+            lambda d: d | dominated_by(xp, rx, x_valid=rv),
+            dom,
+        )
         return dom, None
 
     dom0 = jnp.zeros((padded,), dtype=bool)
-    dom, _ = lax.scan(step, dom0, (rows, rvalid))
+    dom, _ = lax.scan(step, dom0, (rows, rvalid, chunk_min))
     return (~dom & vp)[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
 def dominated_by_blocked(
-    y: jax.Array, x: jax.Array, x_valid: jax.Array | None = None, block: int = 8192
+    y: jax.Array,
+    x: jax.Array,
+    x_valid: jax.Array | None = None,
+    block: int = 8192,
+    y_valid: jax.Array | None = None,
 ) -> jax.Array:
     """Like ``dominated_by`` but scans dominator set ``x`` in ``block``-row
     chunks so the pairwise tile never exceeds (len(y), block). Used for the
     cross-shard prune in the global merge, where the gathered dominator set is
-    P times a shard."""
+    P times a shard, and for the tournament-tree pair merges on CPU.
+
+    Dominator chunks whose smallest valid-row sum exceeds the largest victim
+    sum are skipped outright (sum-bound prune, see ``skyline_mask_scan``).
+    Passing ``y_valid`` tightens that bound to valid victims only — then
+    positions with ``y_valid`` False may be reported undominated where the
+    dense op would say dominated; callers must mask the result by victim
+    validity (every call site in this repo already does)."""
     n, d = x.shape
+    if y.shape[0] == 0:
+        return jnp.zeros((0,), dtype=bool)
     if x_valid is None:
         x_valid = jnp.ones((n,), dtype=bool)
     nb = -(-n // block)
@@ -170,13 +199,25 @@ def dominated_by_blocked(
     xb = x.reshape(nb, block, d)
     vb = x_valid.reshape(nb, block)
 
+    xsums = jnp.where(x_valid, jnp.sum(x, axis=-1), jnp.inf)
+    chunk_min = jnp.min(xsums.reshape(nb, block), axis=1)
+    ysums = jnp.sum(y, axis=-1)
+    if y_valid is not None:
+        ysums = jnp.where(y_valid, ysums, -jnp.inf)
+    victim_max = jnp.max(ysums)
+
     def step(dom, chunk):
-        cx, cv = chunk
-        dom = dom | dominated_by(y, cx, x_valid=cv)
+        cx, cv, mn = chunk
+        dom = lax.cond(
+            mn > victim_max,
+            lambda d: d,
+            lambda d: d | dominated_by(y, cx, x_valid=cv),
+            dom,
+        )
         return dom, None
 
     dom0 = jnp.zeros((y.shape[0],), dtype=bool)
-    dom, _ = lax.scan(step, dom0, (xb, vb))
+    dom, _ = lax.scan(step, dom0, (xb, vb, chunk_min))
     return dom
 
 

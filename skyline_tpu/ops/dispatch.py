@@ -80,6 +80,51 @@ def flush_stage_depth() -> int:
         return 1
 
 
+def merge_tree_enabled() -> bool:
+    """``SKYLINE_MERGE_TREE`` gates the pruned tournament-tree global merge
+    in ``stream/batched.py``: non-empty partitions (minus bound-pruned ones)
+    merge pairwise up a binary tree so each level's quadratic kernel runs on
+    a halved, already-pruned candidate set instead of one O(U²) pass over
+    the full union. Default ON for d > 2 (d <= 2 keeps the sort-sweep flat
+    path, which is strictly cheaper); set ``0`` to force the flat union
+    merge — the A/B baseline tests/test_merge_tree.py and
+    benchmarks/merge_cache.py compare against. Results are byte-identical
+    either way (merge law + stable compaction order). Read lazily per
+    query."""
+    import os
+
+    return os.environ.get("SKYLINE_MERGE_TREE", "1") != "0"
+
+
+def merge_prune_enabled() -> bool:
+    """``SKYLINE_MERGE_PRUNE`` gates the O(P²·d) partition prefilter ahead
+    of the tree merge: partition B is dropped wholesale when another
+    partition's witness point (its min-row-sum survivor) dominates B's
+    min-corner — then it dominates every point of B. The prune relation is
+    a strict partial order (witness chains cannot cycle), so simultaneous
+    pruning is sound and at least one partition always survives. Default
+    ON; set ``0`` to feed every non-empty partition into the tree (the
+    digest check in scripts/obs_smoke.sh compares both settings). Read
+    lazily per query."""
+    import os
+
+    return os.environ.get("SKYLINE_MERGE_PRUNE", "1") != "0"
+
+
+def query_overlap_enabled() -> bool:
+    """``SKYLINE_QUERY_OVERLAP`` gates the overlapped query sync in
+    ``stream/engine.py``: a trigger launches the global merge and returns
+    immediately, ingestion continues while the merge kernels run, and the
+    result is harvested (the only blocking sync) at emission —
+    ``poll_results`` / the next trigger / ``stats()``. Default ON for
+    single-host engines; set ``0`` to restore the blocking
+    launch-then-sync trigger path. Emitted results are identical either
+    way. Read lazily per trigger."""
+    import os
+
+    return os.environ.get("SKYLINE_QUERY_OVERLAP", "1") != "0"
+
+
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
     if x.shape[1] <= 2:

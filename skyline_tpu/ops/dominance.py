@@ -141,3 +141,33 @@ def compact(x: jax.Array, keep: jax.Array, capacity: int):
         vals = jnp.concatenate([x_sorted, pad], axis=0)
     vals = jnp.where(valid[:, None], vals, PAD_VALUE)
     return vals, valid, count
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def compact_tagged(x: jax.Array, tags: jax.Array, keep: jax.Array, capacity: int):
+    """``compact`` threading an integer tag per row through the same stable
+    gather — the tournament-tree merge uses it to carry partition ids
+    alongside survivor points, so per-partition survivor counts fall out of
+    a segment-sum at the root instead of a second pass. The values output
+    is byte-identical to ``compact(x, keep, capacity)[0]``; tags of padding
+    slots are 0.
+    """
+    n = x.shape[0]
+    count = jnp.sum(keep)
+    order = jnp.argsort(~keep, stable=True)
+    x_sorted = x[order]
+    t_sorted = tags[order]
+    slot = jnp.arange(capacity)
+    valid = slot < jnp.minimum(count, capacity)
+    if capacity <= n:
+        vals = x_sorted[:capacity]
+        tout = t_sorted[:capacity]
+    else:
+        pad = jnp.full((capacity - n, x.shape[1]), PAD_VALUE, dtype=x.dtype)
+        vals = jnp.concatenate([x_sorted, pad], axis=0)
+        tout = jnp.concatenate(
+            [t_sorted, jnp.zeros((capacity - n,), dtype=tags.dtype)], axis=0
+        )
+    vals = jnp.where(valid[:, None], vals, PAD_VALUE)
+    tout = jnp.where(valid, tout, 0)
+    return vals, tout, valid, count

@@ -68,11 +68,44 @@ def _dom_tile(d: int, x_ref, y_ref, v_ref):
     return (mx <= 0.0) & (mn < 0.0) & vmask
 
 
+def _tile_sum_skip(d: int, x_ref, y_ref, v_ref):
+    """Sum-bound early exit for one (R, C) tile: if the smallest coordinate
+    sum among VALID dominator rows exceeds the largest victim sum, no pair in
+    the tile can dominate and the compute body is skipped.
+
+    Soundness in f32: rounded addition is monotone, so ``a <= b`` per-dim
+    implies ``sumf(a) <= sumf(b)`` — domination never crosses a strict sum
+    gap. Strict ``>`` is required (a dominator may tie its victim's sum).
+    +inf pad victims give max = inf and suppress the skip (conservative);
+    all-pad / all-invalid dominator tiles give min = inf and always skip —
+    which is where the win is: capacity-bucket overshoot fills whole
+    dominator tiles with padding, and in cross-set merges of sum-sorted
+    survivor prefixes entire (strong, weak) tile pairs clear the gap."""
+    sx = x_ref[0, :]
+    sy = y_ref[0, :]
+    for k in range(1, d):  # static unroll over dimensions
+        sx = sx + x_ref[k, :]
+        sy = sy + y_ref[k, :]
+    sx = jnp.where(v_ref[0, :] > 0.5, sx, jnp.inf)
+    return jnp.min(sx) > jnp.max(sy)
+
+
+def _tile_rank_skip(d: int, x_ref, y_ref, v_ref):
+    """Rank-cascade twin of ``_tile_sum_skip`` over the precomputed int32
+    rank-sum row (row ``d``). Rank domination needs ``rsum_x < rsum_y``
+    strictly, so ``>=`` across the tile bound rules it out (int32 sums are
+    exact — no rounding caveat)."""
+    big = jnp.iinfo(jnp.int32).max
+    sx = jnp.where(v_ref[0, :] > 0.5, x_ref[d, :], big)
+    return jnp.min(sx) >= jnp.max(y_ref[d, :])
+
+
 def _kernel_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     """Triangular variant: inputs are pre-sorted by coordinate sum ascending,
     so a row (dominator) tile strictly after the column (victim) tile in sort
     order can never dominate — the whole tile is skipped. Halves the work of
-    the self-skyline case.
+    the self-skyline case. Surviving tiles still pass the data-dependent
+    sum-bound check (``_tile_sum_skip``) before paying the O(R*C*d) body.
 
     Padding note: +inf pad rows produce diff = inf - y = inf -> mx = inf,
     never <= 0, so padding stays dominance-neutral; inf - inf = nan
@@ -85,8 +118,10 @@ def _kernel_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
 
     @pl.when(i * rt <= j * ct + (ct - 1))
     def _compute():
-        dom = _dom_tile(d, x_ref, y_ref, v_ref)
-        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+        @pl.when(jnp.logical_not(_tile_sum_skip(d, x_ref, y_ref, v_ref)))
+        def _body():
+            dom = _dom_tile(d, x_ref, y_ref, v_ref)
+            out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
 def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
@@ -99,8 +134,10 @@ def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    dom = _dom_tile(d, x_ref, y_ref, v_ref)
-    out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+    @pl.when(jnp.logical_not(_tile_sum_skip(d, x_ref, y_ref, v_ref)))
+    def _compute():
+        dom = _dom_tile(d, x_ref, y_ref, v_ref)
+        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
 def _dom_tile_rank(d: int, x_ref, y_ref, v_ref):
@@ -134,8 +171,10 @@ def _kernel_rank_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
 
     @pl.when(i * rt <= j * ct + (ct - 1))
     def _compute():
-        dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
-        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+        @pl.when(jnp.logical_not(_tile_rank_skip(d, x_ref, y_ref, v_ref)))
+        def _body():
+            dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
+            out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
 def _kernel_rank(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
@@ -145,8 +184,10 @@ def _kernel_rank(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
-    out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+    @pl.when(jnp.logical_not(_tile_rank_skip(d, x_ref, y_ref, v_ref)))
+    def _compute():
+        dom = _dom_tile_rank(d, x_ref, y_ref, v_ref)
+        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
 
 
 def rank_transform(x: jax.Array, valid: jax.Array):

@@ -37,6 +37,8 @@ from skyline_tpu.ops.dispatch import (
     delta_dirty_cutoff,
     flush_stage_depth,
     merge_cache_enabled,
+    merge_prune_enabled,
+    merge_tree_enabled,
     on_tpu,
 )
 from skyline_tpu.stream.window import (
@@ -44,6 +46,8 @@ from skyline_tpu.stream.window import (
     _MIN_CAP,
     _active_bucket,
     _next_pow2,
+    extract_cached_leaf,
+    extract_sky_leaf,
     global_merge_delta_device,
     global_merge_stats_device,
     global_points_device,
@@ -51,9 +55,13 @@ from skyline_tpu.stream.window import (
     meshed_merge_step,
     meshed_sfs_cleanup,
     meshed_sfs_round,
+    partition_summaries_device,
     sfs_cleanup,
     sfs_round,
     sfs_round_single,
+    tree_pair_merge,
+    tree_points_device,
+    tree_stats_device,
 )
 
 
@@ -69,6 +77,52 @@ _PROBE_B = 8192
 # Device-ingest chunks are split/padded to power-of-two buckets capped here,
 # bounding the set of ingest executables.
 _CHUNK_BUCKET_MAX = 65536
+
+
+class _MergeHandle:
+    """An in-flight global merge: every kernel launched, nothing synced.
+
+    Produced by ``PartitionSet.global_merge_launch``; consumed (once) by
+    ``PartitionSet.global_merge_harvest``. Between the two the caller is free
+    to keep ingesting — the handle pins the launch-time epoch vector, so
+    harvest-time bookkeeping knows whether the set moved underneath it.
+    """
+
+    __slots__ = (
+        "key",
+        "epoch",
+        "emit_points",
+        "use_cache",
+        "cached",
+        "result",
+        "stats",
+        "union",
+        "keep",
+        "root_vals",
+        "dirty",
+        "clean_total",
+    )
+
+    def __init__(self):
+        self.cached = False
+        self.result = None
+        self.stats = None
+        self.union = None
+        self.keep = None
+        self.root_vals = None
+        self.dirty = None
+        self.clean_total = 0
+
+    def ready(self) -> bool:
+        """True once harvest would not block (best-effort: backends without
+        ``is_ready`` report False, so callers fall back to a later blocking
+        harvest rather than an early one)."""
+        if self.cached:
+            return True
+        try:
+            return bool(self.stats.is_ready())
+        except AttributeError:
+            return False
 
 
 class PartitionSet:
@@ -226,6 +280,15 @@ class PartitionSet:
         self.merge_delta_merges = 0
         self.merge_delta_rows = 0
         self.last_dirty_fraction: float | None = None
+        # per-partition prune summaries for the tournament-tree merge:
+        # (P, 2d+2) device array [min_corner | witness | min_sum | max_sum],
+        # launched async at flush tails and stamped with the epoch vector it
+        # describes (a stale stamp means a tiny re-launch at merge time)
+        self._summary_dev = None
+        self._summary_epoch: np.ndarray | None = None
+        self.merge_tree_merges = 0
+        self.merge_partitions_pruned = 0
+        self.last_tree_info: dict | None = None
         # a deferred (async-started) count-bound tighten from the last lazy
         # flush, consumed by the next sky_counts()/global merge
         self._tighten_pending = False
@@ -580,6 +643,7 @@ class PartitionSet:
                     staged[s] = _stage(s)
         self._counts_cache = None
         self._host_cache = None
+        self._maybe_launch_summaries()
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _sfs_vmapped(self, rows: list[np.ndarray], max_rows: int):
@@ -975,6 +1039,7 @@ class PartitionSet:
             except AttributeError:
                 pass
             self._tighten_pending = True
+        self._maybe_launch_summaries()
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _flush_sweep(self) -> None:
@@ -1237,32 +1302,65 @@ class PartitionSet:
         Either way the result is byte-identical to the from-scratch
         recompute — tests/test_merge_cache.py property-checks this against
         random flush/query interleavings.
+
+        With ``SKYLINE_MERGE_TREE`` (default on, ``dims > 2``, single
+        device) the union pass is replaced by a pruned tournament tree:
+        whole partitions whose min-corner is strictly dominated by another
+        partition's witness point are dropped before any kernel launches
+        (``SKYLINE_MERGE_PRUNE``), and the survivors merge pairwise up a
+        log-depth binary tree so each level halves the candidate set.
+        Byte-identical to the flat recompute — tests/test_merge_tree.py
+        property-checks the grid.
+
+        This method is ``global_merge_launch`` + ``global_merge_harvest``
+        back to back; callers that want to overlap the merge with further
+        ingest use the two halves directly (stream/engine.py's overlapped
+        query sync).
+        """
+        return self.global_merge_harvest(self.global_merge_launch(emit_points))
+
+    def global_merge_launch(self, emit_points: bool = False) -> _MergeHandle:
+        """Launch the global merge without blocking on the result.
+
+        Every kernel (tree or flat, full or delta) and the stats
+        device->host copy are dispatched here; the returned handle carries
+        the in-flight arrays plus the launch-time epoch identity. The
+        caller may keep flushing new rows before harvesting — harvest
+        detects the moved epoch and skips the count-bound refresh (the
+        cached result itself stays valid under its own key).
         """
         if self._tighten_pending:
             # absorb the flush's async count transfer before sizing any
             # bucket below: the bytes are already in flight, so this sync
             # is cheap and the bounds it tightens halve the pairwise work
             self.sky_counts()
+        h = _MergeHandle()
+        h.emit_points = emit_points
+        h.key = self.epoch_key
+        h.epoch = self._epoch.copy()
         use_cache = merge_cache_enabled() and self.mesh is None
+        h.use_cache = use_cache
         cache = self._gm_cache if use_cache else None
-        key = self.epoch_key
-        if cache is not None and cache["key"] == key:
+        if cache is not None and cache["key"] == h.key:
             # exact hit: no flush touched any partition since this result
-            # was computed — return it without touching the device
+            # was computed — materialize it now (zero kernel launches) so
+            # harvest can't be skewed by a later cache replacement
             self.merge_cache_hits += 1
             self._inc("merge.cache_hit")
             self._counts_cache = cache["counts"].copy()
             self._count_ub = cache["counts"].copy()
-            return (
+            h.cached = True
+            h.result = (
                 cache["counts"].copy(),
                 cache["surv"].copy(),
                 cache["g"],
                 self._cached_points() if emit_points else None,
             )
+            return h
         self.merge_cache_misses += 1
         self._inc("merge.cache_miss")
         P = self.num_partitions
-        dirty = clean_total = None
+        dirty = None
         if cache is not None:
             dirty_mask = self._epoch != cache["epoch"]
             self.last_dirty_fraction = float(dirty_mask.sum()) / P
@@ -1271,66 +1369,108 @@ class PartitionSet:
                 dirty = dirty_mask
         elif use_cache:
             self.last_dirty_fraction = 1.0  # cold miss == everything dirty
+        use_tree = (
+            self.mesh is None and self.dims > 2 and merge_tree_enabled()
+        )
+        stats = None
         if dirty is not None:
-            union, keep, stats, union_cap, clean_total = self._merge_delta(
-                cache, dirty
-            )
+            h.dirty = dirty
+            if use_tree:
+                stats = self._merge_tree_delta(cache, dirty, h)
+            if stats is None:
+                union, keep, stats, _, clean_total = self._merge_delta(
+                    cache, dirty
+                )
+                h.union, h.keep = union, keep
+                h.clean_total = clean_total
         else:
-            # the count upper bounds are maintained without syncs, so these
-            # buckets cost no round trip (pessimistic is safe: rows between
-            # count and active are invalid by the mask; union_cap from the
-            # SUMMED bounds keeps the pass union-sized under routing skew)
-            active = min(
-                self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
-            )
-            # quarter-pow2 ladder on the union too: the triangular pass
-            # costs O(union_cap^2), so the ladder's ~1.14x tighter bucket is
-            # ~1.3x less pairwise work at the north-star union (~437k rows)
-            union_cap = _active_bucket(max(int(self._count_ub.sum()), 1))
-            union, keep, stats = global_merge_stats_device(
-                self.sky, self._count_dev, active, union_cap
-            )
+            if use_tree:
+                stats = self._merge_tree_full(h)
+            if stats is None:
+                # the count upper bounds are maintained without syncs, so
+                # these buckets cost no round trip (pessimistic is safe:
+                # rows between count and active are invalid by the mask;
+                # union_cap from the SUMMED bounds keeps the pass
+                # union-sized under routing skew)
+                active = min(
+                    self._cap,
+                    _active_bucket(max(int(self._count_ub.max()), 1)),
+                )
+                # quarter-pow2 ladder on the union too: the triangular pass
+                # costs O(union_cap^2), so the ladder's ~1.14x tighter
+                # bucket is ~1.3x less pairwise work at the north-star
+                # union (~437k rows)
+                union_cap = _active_bucket(max(int(self._count_ub.sum()), 1))
+                union, keep, stats = global_merge_stats_device(
+                    self.sky, self._count_dev, active, union_cap
+                )
+                h.union, h.keep = union, keep
+        h.stats = stats
         # start the stats transfer before any host-side bookkeeping so the
         # copy overlaps it instead of starting cold inside np.asarray
         try:
             stats.copy_to_host_async()
         except AttributeError:
             pass
+        return h
+
+    def global_merge_harvest(self, handle: _MergeHandle):
+        """Block on an in-flight merge and return ``(counts, surv, g,
+        points | None)`` — the second half of ``global_merge_stats``. The
+        sync cost lands under the ``query/global_stats_sync`` phase; when
+        the caller overlapped enough ingest, the bytes already arrived and
+        the phase records only the harvest."""
+        h = handle
+        if h.cached:
+            return h.result
+        P = self.num_partitions
         with self.tracer.phase("query/global_stats_sync"):
-            svec = np.asarray(stats, dtype=np.int64)
+            svec = np.asarray(h.stats, dtype=np.int64)
         counts, surv, g = svec[:P].copy(), svec[P : 2 * P].copy(), int(svec[2 * P])
-        if dirty is not None:
+        if h.dirty is not None:
             self.merge_delta_merges += 1
-            drows = clean_total + int(counts[dirty].sum())
+            drows = h.clean_total + int(counts[h.dirty].sum())
             self.merge_delta_rows += drows
             self._inc("merge.delta_rows", drows)
         pts = None
-        if use_cache:
+        if h.use_cache:
             # compact the survivors into the cache buffer even when the
             # caller skipped points: the next delta merge reads them, and a
             # later emit_points hit transfers lazily. Capacity 2*pow2(g)
             # keeps the delta kernel's clean dynamic_slice from ever
-            # clamping (lo <= g, clean_active <= pow2(g)).
+            # clamping (lo <= g, clean_active <= pow2(g)). Stored under the
+            # handle's LAUNCH-time key: even if flushes landed since, the
+            # result correctly describes that epoch's state.
             gcap = 2 * _next_pow2(max(g, 1))
+            if h.root_vals is not None:
+                pts_dev = tree_points_device(h.root_vals, gcap)
+            else:
+                pts_dev = global_points_device(h.union, h.keep, gcap)
             self._gm_cache = {
-                "key": key,
-                "epoch": self._epoch.copy(),
+                "key": h.key,
+                "epoch": h.epoch.copy(),
                 "counts": counts.copy(),
                 "surv": surv.copy(),
                 "g": g,
-                "pts_dev": global_points_device(union, keep, gcap),
+                "pts_dev": pts_dev,
                 "pts_host": None,
             }
-            if emit_points:
+            if h.emit_points:
                 pts = self._cached_points()
-        elif emit_points:
+        elif h.emit_points:
             out_cap = _next_pow2(max(g, 1))
             with self.tracer.phase("query/points_transfer"):
-                pts = np.asarray(
-                    global_points_device(union, keep, out_cap)
-                )[:g].copy()
-        self._counts_cache = counts.copy()
-        self._count_ub = counts.copy()
+                if h.root_vals is not None:
+                    pts_dev = tree_points_device(h.root_vals, out_cap)
+                else:
+                    pts_dev = global_points_device(h.union, h.keep, out_cap)
+                pts = np.asarray(pts_dev)[:g].copy()
+        if self.epoch_key == h.key:
+            # only refresh the live count bookkeeping when no flush landed
+            # between launch and harvest — stale counts would corrupt the
+            # capacity upper bounds that size every later bucket
+            self._counts_cache = counts.copy()
+            self._count_ub = counts.copy()
         return counts, surv, g, pts
 
     def _merge_delta(self, cache, dirty: np.ndarray):
@@ -1364,6 +1504,184 @@ class PartitionSet:
             tuple(bool(b) for b in dirty),
         )
         return union, keep, stats, union_cap, clean_total
+
+    # -- pruned tournament-tree merge --------------------------------------
+
+    def _maybe_launch_summaries(self) -> None:
+        """Flush-tail hook: start the per-partition prune summary compute
+        (async, tiny) so the next merge's prefilter reads landed bytes
+        instead of launching cold. Only when the tree + prefilter are both
+        live for this set (``dims > 2``, single device)."""
+        if (
+            self.mesh is None
+            and self.dims > 2
+            and merge_tree_enabled()
+            and merge_prune_enabled()
+        ):
+            self._launch_summaries()
+
+    def _launch_summaries(self) -> None:
+        active = min(
+            self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
+        )
+        self._summary_dev = partition_summaries_device(
+            self.sky, self._count_dev, active
+        )
+        try:
+            self._summary_dev.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._summary_epoch = self._epoch.copy()
+
+    def _tree_summaries(self) -> np.ndarray:
+        """Host copy of the (P, 2d+2) prune summaries for the CURRENT
+        epoch — usually already in flight from the flush-tail launch;
+        re-launched (one tiny kernel) when the stamp is stale."""
+        if self._summary_epoch is None or not np.array_equal(
+            self._summary_epoch, self._epoch
+        ):
+            self._launch_summaries()
+        return np.asarray(self._summary_dev)
+
+    def _prune_mask(self, alive: np.ndarray) -> np.ndarray:
+        """The O(P²·d) bound-dominance prefilter: partition B is pruned
+        when some partition A's witness (its min-row-sum live point — a
+        REAL point, not a bound) strictly dominates B's min-corner, because
+        then the witness dominates every point of B
+        (``witness[k] < min_corner_B[k] <= b[k]``). Strict dominance is a
+        strict partial order, so simultaneous pruning is acyclic: every
+        pruned partition's dominator chain ends at a surviving partition's
+        witness, and at least one alive partition always survives —
+        dropping pruned partitions leaves the skyline byte-identical."""
+        d = self.dims
+        s = self._tree_summaries()
+        mins = s[:, :d]
+        wit = s[:, d : 2 * d]
+        pruned = np.zeros(self.num_partitions, dtype=bool)
+        for a in np.flatnonzero(alive):
+            w = wit[a]
+            if not np.all(np.isfinite(w)):
+                continue  # empty partition: +inf witness prunes nothing
+            dom = np.all(w[None, :] <= mins, axis=1) & np.any(
+                w[None, :] < mins, axis=1
+            )
+            dom[a] = False  # a witness never beats its own min-corner
+            pruned |= dom & alive
+        return pruned
+
+    def _merge_tree_full(self, h: _MergeHandle):
+        """Assemble + launch the pruned tournament tree over the current
+        partition skylines. Returns the packed stats device vector, or
+        ``None`` to fall back to the flat union pass (no live leaves)."""
+        alive = self._count_ub > 0
+        considered = int(alive.sum())
+        npruned = 0
+        if merge_prune_enabled() and considered > 1:
+            pruned = self._prune_mask(alive)
+            npruned = int(pruned.sum())
+            leaf_mask = alive & ~pruned
+        else:
+            leaf_mask = alive
+        pids = np.flatnonzero(leaf_mask)
+        if pids.size == 0:
+            return None  # empty set: the flat pass handles the zero state
+        leaves = []
+        for p in pids:
+            w = min(
+                self._cap,
+                _active_bucket(max(int(self._count_ub[p]), 1)),
+            )
+            vals, lpids, cnt = extract_sky_leaf(
+                self.sky, self._count_dev, int(p), w
+            )
+            leaves.append((vals, lpids, cnt, int(self._count_ub[p])))
+        return self._run_tree(leaves, h, npruned, considered)
+
+    def _merge_tree_delta(self, cache, dirty: np.ndarray, h: _MergeHandle):
+        """Delta merge routed through the tree: dirty partitions feed
+        their skylines as leaves, clean partitions feed their cached
+        global-survivor segments (masked past the true width — the tail
+        rows belong to the NEXT partitions' survivors). Leaves stay in
+        ascending pid order, so the root is byte-identical to the flat
+        delta's compaction. No prefilter here: the witness summaries
+        describe partition skylines, not cached survivor segments."""
+        surv = cache["surv"]
+        bounds = np.concatenate(([0], np.cumsum(surv))).astype(np.int64)
+        gpts = cache["pts_dev"]
+        leaves = []
+        clean_total = 0
+        for p in range(self.num_partitions):
+            if dirty[p]:
+                if self._count_ub[p] <= 0:
+                    continue
+                w = min(
+                    self._cap,
+                    _active_bucket(max(int(self._count_ub[p]), 1)),
+                )
+                vals, lpids, cnt = extract_sky_leaf(
+                    self.sky, self._count_dev, int(p), w
+                )
+                leaves.append((vals, lpids, cnt, int(self._count_ub[p])))
+            else:
+                sw = int(surv[p])
+                if sw <= 0:
+                    continue
+                clean_total += sw
+                w = _active_bucket(sw)
+                vals, lpids, cnt = extract_cached_leaf(
+                    gpts,
+                    jnp.asarray(np.int32(bounds[p])),
+                    jnp.asarray(np.int32(sw)),
+                    int(p),
+                    w,
+                )
+                leaves.append((vals, lpids, cnt, sw))
+        h.clean_total = clean_total
+        if not leaves:
+            return None
+        return self._run_tree(leaves, h, 0, len(leaves))
+
+    def _run_tree(self, leaves, h: _MergeHandle, npruned: int, considered: int):
+        """Pair the leaves up a binary tree (adjacent pairs, odd tail
+        passes through) so pid order — and therefore byte identity with the
+        flat pass's stable compaction — is preserved at every level. Each
+        node's capacity covers the sum of its children's count upper
+        bounds, so ``compact`` never silently clips."""
+        levels = 0
+        cand = [len(leaves)]
+        nodes = leaves
+        while len(nodes) > 1:
+            levels += 1
+            nxt = []
+            for i in range(0, len(nodes) - 1, 2):
+                av, ap, ac, aub = nodes[i]
+                bv, bp, bc, bub = nodes[i + 1]
+                out_cap = _active_bucket(max(aub + bub, 1))
+                vals, pids_out, cnt = tree_pair_merge(
+                    av, ap, ac, bv, bp, bc, out_cap
+                )
+                nxt.append((vals, pids_out, cnt, min(aub + bub, out_cap)))
+            if len(nodes) % 2:
+                nxt.append(nodes[-1])
+            nodes = nxt
+            cand.append(len(nodes))
+        root_vals, root_pids, root_cnt, _ = nodes[0]
+        h.root_vals = root_vals
+        self.merge_tree_merges += 1
+        self.merge_partitions_pruned += npruned
+        # inc even when zero so the Prometheus series registers on the
+        # first tree merge, not the first nonzero prune
+        self._inc("merge.tree_levels", levels)
+        self._inc("merge.partitions_pruned", npruned)
+        self.last_tree_info = {
+            "levels": levels,
+            "partitions_pruned": npruned,
+            "candidates_per_level": cand,
+            "pruned_fraction": (npruned / considered) if considered else 0.0,
+        }
+        return tree_stats_device(
+            self._count_dev, root_pids, root_cnt, self.num_partitions
+        )
 
     def _cached_points(self) -> np.ndarray:
         """Host copy of the cached global skyline points, transferred at

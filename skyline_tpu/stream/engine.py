@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from skyline_tpu.ops.dispatch import skyline_keep_np
+from skyline_tpu.ops.dispatch import query_overlap_enabled, skyline_keep_np
 from skyline_tpu.parallel.partitioners import partition_ids_np
 from skyline_tpu.bridge.wire import parse_trigger
 from skyline_tpu.stream.batched import PartitionSet, PartitionView
@@ -214,6 +214,12 @@ class SkylineEngine:
         self.dropped = 0
         self.prefiltered = 0
         self._midpoint_witness = False  # grid_prefilter safety latch
+        # overlapped query sync (SKYLINE_QUERY_OVERLAP): at most one global
+        # merge in flight as (query, handle, now_ms, flush_wall_ms,
+        # launch_ms) — launched at trigger time, harvested at the next
+        # result drain / trigger / stats poll, or opportunistically while
+        # ingesting once the stats bytes have landed
+        self._inflight_merge: tuple | None = None
         # serving plane (serve/snapshot.py): when attached, every completed
         # global skyline publishes as an immutable versioned snapshot and
         # every ingest micro-batch advances its staleness counter
@@ -270,6 +276,7 @@ class SkylineEngine:
                 for p in range(cfg.num_partitions):
                     now_ms = self._recheck_pending(p, now_ms)
             self.pset.maybe_flush()
+            self._harvest_inflight(block=False)
             return
         with self.tracer.phase("partition_ids"):
             pids = partition_ids_np(
@@ -328,6 +335,9 @@ class SkylineEngine:
             # this batch have routed, so answers reflect the full batch)
             for p in doomed_pids:
                 now_ms = self._recheck_pending(int(p), now_ms)
+        # an overlapped merge whose bytes already landed costs ~nothing to
+        # harvest here; one that hasn't stays in flight (never block ingest)
+        self._harvest_inflight(block=False)
 
     # -- control plane ----------------------------------------------------
 
@@ -342,6 +352,10 @@ class SkylineEngine:
         host; the full local-skyline buffers are never transferred."""
         if now_ms is None:
             now_ms = time.time() * 1000.0
+        # a previous overlapped merge lands before a new query dispatches:
+        # results stay in trigger order and the engine keeps at most one
+        # merge in flight
+        self._harvest_inflight()
         if self.pset.has_unsynced_ingest:
             # barrier checks below read per-partition max ids
             self.pset.sync_ingest_bookkeeping()
@@ -584,6 +598,18 @@ class SkylineEngine:
         want_points = (
             self.config.emit_skyline_points or self.snapshots is not None
         )
+        if query_overlap_enabled() and self.mesh is None:
+            # overlapped sync: launch every merge kernel now, keep the
+            # handle, and return — ingest continues while the device works.
+            # The result emits at the next harvest point (poll_results /
+            # next trigger / stats / timeout check, or opportunistically in
+            # process_records once the stats bytes land), where the phase
+            # records only the residual harvest time instead of the full
+            # merge wall.
+            handle = self.pset.global_merge_launch(emit_points=want_points)
+            launch_ms = (time.perf_counter_ns() - t1) / 1e6
+            self._inflight_merge = (q, handle, now_ms, flush_wall_ms, launch_ms)
+            return
         counts, surv, g, pts = self.pset.global_merge_stats(
             emit_points=want_points
         )
@@ -595,12 +621,53 @@ class SkylineEngine:
                 args={"skyline_size": int(g)},
             )
             tel.histogram("global_merge_ms").observe(merge_ms)
+        self._emit_device_result(
+            q, now_ms, flush_wall_ms, merge_ms, counts, surv, g, pts,
+            source_key=self.pset.epoch_key,
+        )
+
+    def _harvest_inflight(self, block: bool = True) -> bool:
+        """Land the overlapped merge, if one is in flight. ``block=False``
+        harvests only when the stats transfer already completed (an
+        effectively-free sync) — the ingest path uses it so a still-running
+        merge never stalls new data. Returns True when a result emitted."""
+        if self._inflight_merge is None:
+            return False
+        q, handle, now_ms, flush_wall_ms, launch_ms = self._inflight_merge
+        if not block and not handle.ready():
+            return False
+        self._inflight_merge = None
+        h0 = time.perf_counter_ns()
+        counts, surv, g, pts = self.pset.global_merge_harvest(handle)
+        h1 = time.perf_counter_ns()
+        # the query's merge cost = launch dispatch + harvest sync; the
+        # in-flight span in between ran under ingest, so charging it here
+        # would double-count the overlap the split exists to buy
+        merge_ms = launch_ms + (h1 - h0) / 1e6
+        if self.telemetry is not None:
+            self.telemetry.spans.record(
+                "merge", h0, h1, trace_id=q.trace_id,
+                args={"skyline_size": int(g), "overlapped": True},
+            )
+            self.telemetry.histogram("global_merge_ms").observe(merge_ms)
+        self._emit_device_result(
+            q, now_ms, flush_wall_ms, merge_ms, counts, surv, g, pts,
+            source_key=handle.key,
+        )
+        return True
+
+    def _emit_device_result(
+        self, q, now_ms, flush_wall_ms, merge_ms, counts, surv, g, pts,
+        source_key,
+    ) -> None:
+        """Shared tail of the device answer paths (blocking + overlapped):
+        snapshot publish, timing decomposition, result emission."""
         if self.snapshots is not None:
             # the epoch key identifies the flushed state the merge saw, so
             # repeated triggers over unchanged state dedupe in the store
             # (the host _finalize path publishes un-keyed: its unions mix
             # per-partition arrival times, so no single key describes them)
-            self._publish_snapshot(pts, q, source_key=self.pset.epoch_key)
+            self._publish_snapshot(pts, q, source_key=source_key)
 
         starts = [s for s in self.pset.start_time_ms if s is not None]
         map_finish = now_ms + flush_wall_ms
@@ -629,7 +696,12 @@ class SkylineEngine:
         its pending barrier entries are withdrawn. Returns the number of
         queries timed out."""
         timeout = self.config.query_timeout_ms
-        if timeout <= 0 or not self._inflight:
+        if timeout <= 0:
+            return 0
+        # an overlapped merge's query is still in _inflight; land it before
+        # the scan so the watchdog can't double-finalize it as partial
+        self._harvest_inflight()
+        if not self._inflight:
             return 0
         if now_ms is None:
             now_ms = time.time() * 1000.0
@@ -652,6 +724,7 @@ class SkylineEngine:
     # -- results ----------------------------------------------------------
 
     def poll_results(self) -> list[dict]:
+        self._harvest_inflight()
         out, self._results = self._results, []
         return out
 
@@ -670,6 +743,9 @@ class SkylineEngine:
         """
         if self.pset.has_unsynced_ingest:
             self.pset.sync_ingest_bookkeeping()
+        # counters below must describe a settled state, not a merge mid-air
+        self._harvest_inflight()
+        tree_info = self.pset.last_tree_info or {}
         out = {
             "records_in": self.records_in,
             "dropped": self.dropped,
@@ -688,6 +764,15 @@ class SkylineEngine:
                 "delta_merges": self.pset.merge_delta_merges,
                 "delta_rows": self.pset.merge_delta_rows,
                 "last_dirty_fraction": self.pset.last_dirty_fraction,
+            },
+            "merge_tree": {
+                "merges": self.pset.merge_tree_merges,
+                "levels": tree_info.get("levels", 0),
+                "partitions_pruned": self.pset.merge_partitions_pruned,
+                "pruned_fraction": tree_info.get("pruned_fraction", 0.0),
+                "candidates_per_level": tree_info.get(
+                    "candidates_per_level", []
+                ),
             },
         }
         if include_skyline_counts:

@@ -545,6 +545,15 @@ class SlidingEngine:
     def stats(self, include_skyline_counts: bool = False) -> dict:
         out = {
             "mode": "sliding",
+            # which skyline-mask kernel the slide step runs: "pallas" means
+            # the VMEM-tiled triangular kernels WITH the sorted-order tile
+            # skip (ops/pallas_dominance.py), the fast path the tree merge
+            # shares; "sweep"/"scan" are the d<=2 and portable fallbacks
+            "mask_kernel": (
+                "sweep"
+                if self.config.dims <= 2
+                else ("pallas" if self._use_pallas else "scan")
+            ),
             "records_in": self.records_in,
             "dropped": self.dropped,
             "prefiltered": self.prefiltered,

@@ -359,6 +359,167 @@ def global_merge_delta_device(
     return u, keep, stats
 
 
+# --- Pruned tournament-tree global merge -----------------------------------
+#
+# The flat ``global_merge_stats_device`` pays one O(U²) dominance pass over
+# the full union. The tree path instead (1) drops whole partitions via a
+# host-side witness prefilter over tiny device summaries, then (2) merges the
+# survivors pairwise up a binary tree — each level's pair merge prunes both
+# sides, so the next level's quadratic kernel runs on a halved, already-
+# thinned candidate set. Every primitive below preserves the flat path's
+# survivor ORDER (ascending partition id, storage row within a partition —
+# the order the flat gather writes and ``compact``'s stable sort keeps), so
+# the tree's output bytes are identical to the flat recompute's.
+# Orchestration lives in ``stream.batched.PartitionSet``.
+
+
+@functools.partial(jax.jit, static_argnames=("active",))
+def partition_summaries_device(sky, counts, active: int):
+    """Per-partition prune summaries, (P, 2d + 2) packed as
+    ``[min_corner (d) | witness (d) | min_sum | max_sum]``.
+
+    ``witness`` is an ACTUAL live point of the partition — the row with the
+    smallest coordinate sum (the best single-dominator candidate under
+    minimization). The host prefilter prunes partition B when some other
+    partition's witness dominates B's min-corner: the witness is then <=
+    every B point in all dims and strictly below in the witnessing dim
+    (witness_k < min_corner_k <= b_k), i.e. it strictly dominates ALL of B.
+    Empty partitions report +inf everywhere and can neither prune nor
+    survive. Launched asynchronously at flush time (a (P, 2d+2) transfer);
+    the merge path re-launches only if the epoch moved since."""
+    P, cap, d = sky.shape
+    s = lax.slice(sky, (0, 0, 0), (P, active, d))
+    valid = jnp.arange(active)[None, :] < counts[:, None]
+    sm = jnp.where(valid[:, :, None], s, jnp.inf)
+    min_corner = jnp.min(sm, axis=1)
+    sums = jnp.where(valid, jnp.sum(s, axis=2), jnp.inf)
+    wi = jnp.argmin(sums, axis=1)
+    witness = jnp.take_along_axis(
+        s, jnp.broadcast_to(wi[:, None, None], (P, 1, d)), axis=1
+    ).reshape(P, d)
+    witness = jnp.where((counts > 0)[:, None], witness, jnp.inf)
+    min_sum = jnp.min(sums, axis=1)
+    max_sum = jnp.max(jnp.where(valid, jnp.sum(s, axis=2), -jnp.inf), axis=1)
+    return jnp.concatenate(
+        [min_corner, witness, min_sum[:, None], max_sum[:, None]], axis=1
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("p", "width"))
+def extract_sky_leaf(sky, counts, p: int, width: int):
+    """One partition's live prefix as a tree leaf: (vals (width, d),
+    pids (width,), count). ``width`` must cover the partition's count (the
+    caller buckets its count upper bound); rows >= count are +inf padding by
+    the storage invariant. Static (p, width) keeps the executable set
+    bounded by P x capacity buckets."""
+    P, cap, d = sky.shape
+    vals = lax.slice(sky, (p, 0, 0), (p + 1, width, d)).reshape(width, d)
+    pids = jnp.full((width,), p, jnp.int32)
+    return vals, pids, counts[p].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "width"))
+def extract_cached_leaf(gpts, lo, w, p: int, width: int):
+    """A CLEAN partition's cached global-survivor segment as a tree leaf for
+    the delta merge: rows [lo, lo+w) of the cached points buffer. The static
+    ``width`` slice is masked past the true width ``w`` — rows beyond the
+    segment are the NEXT partitions' cached survivors, not padding (the same
+    hazard ``global_merge_delta_device`` documents). ``gpts`` capacity must
+    be >= lo + width so the dynamic_slice never clamps backward (the cache
+    pads to 2*next_pow2(g); width <= next_pow2(g) and lo <= g)."""
+    d = gpts.shape[1]
+    zero = jnp.zeros((), jnp.int32)
+    sl = lax.dynamic_slice(gpts, (lo, zero), (width, d))
+    sl = jnp.where(jnp.arange(width)[:, None] < w, sl, jnp.inf)
+    pids = jnp.full((width,), p, jnp.int32)
+    return sl, pids, w.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def tree_pair_merge(a, apids, acnt, b, bpids, bcnt, out_cap: int):
+    """Merge two tree nodes — each already a skyline (mutually
+    non-dominated) — and compact survivors in [a-order, b-order].
+
+    Exactness without a self-prune pass: if a b-point y dominated an
+    a-point x while y itself were dominated by some a-point w, transitivity
+    would give w dominates x — impossible inside a skyline. So any b-point
+    that dominates an a-point necessarily survives pass one, and checking a
+    against only SURVIVING b-points (pass two) is exact; symmetrically the
+    full valid a set is a correct dominator set for b. Two rectangular
+    passes instead of ``_merge_step_core``'s three.
+
+    Order: stable compaction of [a | b]. With leaves fed in ascending
+    partition id, every level preserves (pid, storage-row) order, so the
+    root's bytes equal the flat merge's compacted output. ``out_cap`` must
+    be >= acnt + bcnt (callers bucket the summed upper bounds). Partition
+    ids ride along for the root's per-partition survivor stats."""
+    from skyline_tpu.ops.block_skyline import dominated_by_blocked
+    from skyline_tpu.ops.dispatch import on_tpu
+    from skyline_tpu.ops.dominance import compact_tagged
+
+    wa, d = a.shape
+    wb = b.shape[0]
+    av = jnp.arange(wa) < acnt
+    bv = jnp.arange(wb) < bcnt
+    if on_tpu():
+        from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
+
+        interp = _pallas_interpret()
+        at, bt = a.T, b.T
+        keep_b = bv & ~dominated_by_pallas(at, av, bt, interpret=interp)
+        keep_a = av & ~dominated_by_pallas(bt, keep_b, at, interpret=interp)
+    else:
+        # chunk the dominator set so the dense tile stays ~256 MB; victim
+        # validity tightens the sum-bound chunk skip (invalid victims may
+        # then read undominated — masked by av/bv below)
+        blk = max(256, min(8192, (1 << 28) // max(wb, 1)))
+        keep_b = bv & ~dominated_by_blocked(
+            b, a, x_valid=av, block=blk, y_valid=bv
+        )
+        blk = max(256, min(8192, (1 << 28) // max(wa, 1)))
+        keep_a = av & ~dominated_by_blocked(
+            a, b, x_valid=keep_b, block=blk, y_valid=av
+        )
+    x = jnp.concatenate([a, b], axis=0)
+    t = jnp.concatenate([apids, bpids], axis=0)
+    keep = jnp.concatenate([keep_a, keep_b], axis=0)
+    vals, pids, _, cnt = compact_tagged(x, t, keep, out_cap)
+    return vals, pids, cnt.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def tree_stats_device(counts, root_pids, root_cnt, num_partitions: int):
+    """Pack the tree root into the flat merge's stats layout
+    ``[counts (P,) | survivors_per_partition (P,) | global_count]`` so the
+    caller's sync / cache paths are shared. Per-partition survivors fall out
+    of a segment-sum over the partition ids the pair merges threaded
+    through; pruned and empty partitions report 0."""
+    w = root_pids.shape[0]
+    valid = jnp.arange(w) < root_cnt
+    surv = jax.ops.segment_sum(
+        valid.astype(jnp.int32),
+        jnp.where(valid, root_pids, 0),
+        num_segments=num_partitions,
+    )
+    return jnp.concatenate(
+        [counts.astype(jnp.int32), surv, root_cnt.astype(jnp.int32)[None]]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("out_cap",))
+def tree_points_device(vals, out_cap: int):
+    """Resize the tree root's value buffer to the points transfer / cache
+    capacity. Rows past the survivor count are already +inf (compact
+    invariant, or the sky storage invariant for a single-leaf root), so a
+    plain slice / pad reproduces ``global_points_device``'s bytes."""
+    w, d = vals.shape
+    if out_cap <= w:
+        return lax.slice(vals, (0, 0), (out_cap, d))
+    return jnp.concatenate(
+        [vals, jnp.full((out_cap - w, d), jnp.inf, vals.dtype)], axis=0
+    )
+
+
 def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):
     """``jit(shard_map(vmap(fn)))`` over the partition axis — the one shared
     wrapper for every meshed per-partition kernel. All inputs and outputs

@@ -25,15 +25,53 @@ _PROBE_SRC = (
     " 'n_devices': len(jax.devices())}))"
 )
 
+# Process-lifetime verdict cache: the backend a probe reports cannot change
+# within one process (the plugin either resolves or it doesn't), so repeat
+# callers — bench legs, dryrun entries — reuse the first verdict instead of
+# paying the subprocess (and, on a dead tunnel, the full timeout) again.
+# Keyed on nothing: one verdict per process. ``cached: True`` marks reuse.
+_VERDICT: dict | None = None
 
-def probe_backend(
-    timeout_s: float, attempts: int = 1, backoff_s: float = 0.0
-) -> dict:
-    """Returns ``{"backend": str|None, "n_devices": int, "attempts": int,
-    "errors": [str], "probe_s": float}``; ``backend`` is None if every
-    attempt failed or timed out."""
+
+def probe_timeout_s(default: float = 150.0) -> float:
+    """Resolve the probe timeout: ``SKYLINE_PROBE_TIMEOUT_S`` wins, then the
+    legacy ``BENCH_PROBE_TIMEOUT``, then ``default``."""
     import os
 
+    for var in ("SKYLINE_PROBE_TIMEOUT_S", "BENCH_PROBE_TIMEOUT"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return float(v)
+            except ValueError:
+                pass
+    return default
+
+
+def probe_backend(
+    timeout_s: float,
+    attempts: int = 1,
+    backoff_s: float = 0.0,
+    use_cache: bool = True,
+) -> dict:
+    """Returns ``{"backend": str|None, "n_devices": int, "attempts": int,
+    "errors": [str], "probe_s": float, "probe_total_s": float}``;
+    ``backend`` is None if every attempt failed or timed out.
+
+    ``probe_total_s`` covers the WHOLE call including failed attempts and
+    backoff sleeps (``probe_s`` keeps its original meaning: the one
+    successful attempt), so wasted probe time is visible in artifacts.
+    The verdict is cached for the process lifetime (``use_cache=False``
+    forces a re-probe).
+    """
+    import os
+
+    global _VERDICT
+    if use_cache and _VERDICT is not None:
+        out = dict(_VERDICT)
+        out["cached"] = True
+        return out
+    wall0 = time.time()
     diag: dict = {"attempts": 0, "errors": [], "n_devices": 0}
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     for i in range(attempts):
@@ -53,6 +91,8 @@ def probe_backend(
                     info = json.loads(r.stdout.strip().splitlines()[-1])
                     diag.update(info)
                     diag["probe_s"] = round(time.time() - t0, 1)
+                    diag["probe_total_s"] = round(time.time() - wall0, 1)
+                    _VERDICT = dict(diag)
                     return diag
                 except (ValueError, IndexError):
                     err = (
@@ -75,4 +115,6 @@ def probe_backend(
         if i + 1 < attempts:
             time.sleep(backoff_s)
     diag["backend"] = None
+    diag["probe_total_s"] = round(time.time() - wall0, 1)
+    _VERDICT = dict(diag)
     return diag

@@ -1,0 +1,231 @@
+"""Pruned tournament-tree global merge (ISSUE 4): byte identity with the
+flat union pass across workload shapes, pruning edge cases, delta merges
+routed through the tree, and the overlapped query sync emitting the same
+results as the blocking path under interleaved flushes."""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
+
+
+def _gen(rng, n, d, kind):
+    if kind == "uniform":
+        return rng.random((n, d)).astype(np.float32)
+    if kind == "correlated":
+        base = rng.random((n, 1))
+        return np.clip(
+            base + rng.normal(0.0, 0.05, (n, d)), 0.0, 1.0
+        ).astype(np.float32)
+    # anti-correlated: first dim fights the second, rest random
+    base = rng.random((n, d))
+    x = base.copy()
+    x[:, 0] = 1.0 - base[:, min(1, d - 1)]
+    return x.astype(np.float32)
+
+
+def _fill(pset, rng, x, P):
+    pids = rng.integers(0, P, x.shape[0])
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=x.shape[0], now_ms=0.0)
+    pset.flush_all()
+
+
+def _merge(pset):
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    return np.asarray(counts), np.asarray(surv), int(g), pts
+
+
+def _assert_same(a, b, ctx=""):
+    assert (a[0] == b[0]).all(), f"counts diverge {ctx}"
+    assert (a[1] == b[1]).all(), f"survivors diverge {ctx}"
+    assert a[2] == b[2], f"global count diverges {ctx}"
+    assert a[3].tobytes() == b[3].tobytes(), f"points diverge {ctx}"
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+@pytest.mark.parametrize("P", [1, 3, 8])
+@pytest.mark.parametrize("prune", ["1", "0"])
+def test_tree_matches_flat(monkeypatch, kind, d, P, prune):
+    """Property grid: the tree (with and without the witness prefilter) is
+    byte-identical to the flat union pass. d=2 exercises the unchanged
+    sweep path, so the grid also pins that the knobs are inert there."""
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    monkeypatch.setenv("SKYLINE_MERGE_PRUNE", prune)
+    results = {}
+    for tree in ("1", "0"):
+        monkeypatch.setenv("SKYLINE_MERGE_TREE", tree)
+        rng = np.random.default_rng(17)
+        pset = PartitionSet(P, d)
+        _fill(pset, rng, _gen(rng, int(1200), d, kind), P)
+        results[tree] = _merge(pset)
+    _assert_same(
+        results["1"], results["0"], f"(kind={kind} d={d} P={P} prune={prune})"
+    )
+
+
+def test_all_partitions_pruned_but_one(monkeypatch):
+    """A near-origin partition whose witness dominates every other
+    partition's min-corner prunes all of them: the tree degenerates to one
+    leaf and still matches the flat recompute exactly."""
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    P, d = 8, 4
+
+    def build(tree, prune):
+        monkeypatch.setenv("SKYLINE_MERGE_TREE", tree)
+        monkeypatch.setenv("SKYLINE_MERGE_PRUNE", prune)
+        rng = np.random.default_rng(3)
+        pset = PartitionSet(P, d)
+        strong = (rng.random((64, d)) * 0.01).astype(np.float32)
+        pset.add_batch(0, strong, max_id=64, now_ms=0.0)
+        for p in range(1, P):
+            weak = (0.5 + rng.random((400, d)) * 0.5).astype(np.float32)
+            pset.add_batch(p, weak, max_id=4000, now_ms=0.0)
+        pset.flush_all()
+        return pset, _merge(pset)
+
+    pruned_set, pruned = build("1", "1")
+    noprune_set, noprune = build("1", "0")
+    _, flat = build("0", "1")
+    _assert_same(pruned, flat, "(pruned tree vs flat)")
+    _assert_same(noprune, flat, "(unpruned tree vs flat)")
+    assert pruned_set.last_tree_info["partitions_pruned"] == P - 1
+    assert pruned_set.last_tree_info["levels"] == 0  # single surviving leaf
+    assert noprune_set.last_tree_info["partitions_pruned"] == 0
+    assert noprune_set.last_tree_info["levels"] == 3  # 8 -> 4 -> 2 -> 1
+    # all weak partitions contribute zero survivors either way
+    assert (np.asarray(pruned[1])[1:] == 0).all()
+
+
+def test_single_nonempty_partition(monkeypatch):
+    """One live partition: the tree is a lone leaf (levels 0) and its
+    result matches the flat pass byte for byte."""
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    P, d = 8, 3
+    results = {}
+    for tree in ("1", "0"):
+        monkeypatch.setenv("SKYLINE_MERGE_TREE", tree)
+        rng = np.random.default_rng(9)
+        pset = PartitionSet(P, d)
+        pset.add_batch(
+            2, rng.random((700, d)).astype(np.float32), max_id=700, now_ms=0.0
+        )
+        pset.flush_all()
+        results[tree] = (_merge(pset), pset.last_tree_info)
+    _assert_same(results["1"][0], results["0"][0], "(single partition)")
+    assert results["1"][1]["levels"] == 0
+    assert results["0"][1] is None  # flat path never ran the tree
+
+
+def test_delta_merges_route_through_tree(monkeypatch):
+    """With the epoch cache live, dirty-subset merges feed dirty skylines
+    and cached clean segments as tree leaves — results stay byte-identical
+    to the flat delta across interleaved flush/trigger rounds."""
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "1")
+    P, d = 8, 3
+
+    def run(tree):
+        monkeypatch.setenv("SKYLINE_MERGE_TREE", tree)
+        rng = np.random.default_rng(7)
+        pset = PartitionSet(P, d)
+        out = []
+        for rnd in range(6):
+            x = rng.random((900, d)).astype(np.float32)
+            pids = rng.integers(0, P, len(x))
+            live = range(P) if rnd < 2 else range(rnd % P, (rnd % P) + 2)
+            for p in live:
+                rows = np.ascontiguousarray(x[pids == p])
+                if rows.shape[0]:
+                    pset.add_batch(p, rows, max_id=len(x), now_ms=0.0)
+            pset.flush_all()
+            out.append(_merge(pset))
+            # repeat trigger over unchanged state: exact cache hit
+            out.append(_merge(pset))
+        return out, pset
+
+    a, pa = run("1")
+    b, pb = run("0")
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        _assert_same(ra, rb, f"(round {i})")
+    # both sides took the same hit/miss/delta decisions
+    assert pa.merge_cache_hits == pb.merge_cache_hits > 0
+    assert pa.merge_delta_merges == pb.merge_delta_merges > 0
+    # the tree side actually ran tree merges; zero launches on exact hits
+    assert pa.merge_tree_merges > 0
+    assert pb.merge_tree_merges == 0
+
+
+@pytest.mark.parametrize("flush_policy", ["incremental", "lazy"])
+def test_overlapped_sync_matches_blocking(monkeypatch, flush_policy):
+    """The overlapped query sync (merge launched at trigger, harvested at
+    the next drain) emits the same results as the blocking path while
+    flushes land between launch and harvest."""
+
+    def run(overlap):
+        monkeypatch.setenv("SKYLINE_QUERY_OVERLAP", overlap)
+        rng = np.random.default_rng(11)
+        eng = SkylineEngine(
+            EngineConfig(
+                parallelism=2,
+                dims=3,
+                emit_skyline_points=True,
+                flush_policy=flush_policy,
+            )
+        )
+        out = []
+        nid = 0
+        overlapped = 0
+        for rnd in range(4):
+            x = rng.random((1500, 3)).astype(np.float32)
+            ids = np.arange(nid, nid + len(x))
+            nid += len(x)
+            eng.process_records(ids, x, now_ms=float(rnd))
+            # required=0: the barrier passes on every partition, so the
+            # trigger takes the device-merge path (launch-at-trigger)
+            eng.process_trigger(f"q{rnd},0", now_ms=rnd + 0.5)
+            overlapped += eng._inflight_merge is not None
+            # more ingest lands (and flushes) while the merge is in flight
+            y = rng.random((800, 3)).astype(np.float32)
+            ids = np.arange(nid, nid + len(y))
+            nid += len(y)
+            eng.process_records(ids, y, now_ms=rnd + 0.7)
+            out.extend(eng.poll_results())
+        if overlap == "1":
+            assert overlapped == 4  # every trigger actually launched async
+        else:
+            assert overlapped == 0
+        return out
+
+    a = run("1")
+    b = run("0")
+    assert len(a) == len(b) == 4
+    for ra, rb in zip(a, b):
+        assert ra["query_id"] == rb["query_id"]
+        assert ra["skyline_size"] == rb["skyline_size"]
+        assert sorted(map(tuple, ra["skyline_points"])) == sorted(
+            map(tuple, rb["skyline_points"])
+        )
+
+
+def test_overlap_consecutive_triggers(monkeypatch):
+    """A second trigger harvests the first's in-flight merge before
+    launching its own: results emit in trigger order, one per query."""
+    monkeypatch.setenv("SKYLINE_QUERY_OVERLAP", "1")
+    rng = np.random.default_rng(5)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, dims=3, emit_skyline_points=True)
+    )
+    x = rng.random((3000, 3)).astype(np.float32)
+    eng.process_records(np.arange(3000), x, now_ms=0.0)
+    eng.process_trigger("qa,0", now_ms=1.0)
+    assert eng._inflight_merge is not None  # qa launched, not yet emitted
+    eng.process_trigger("qb,0", now_ms=2.0)
+    res = eng.poll_results()
+    assert [r["query_id"] for r in res] == ["qa", "qb"]
+    assert res[0]["skyline_size"] == res[1]["skyline_size"]
+    # the repeat trigger over unchanged state was a pure cache hit
+    assert eng.pset.merge_cache_hits >= 1
