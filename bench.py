@@ -95,7 +95,7 @@ def run_window(cfg, ids, x, required, tracer=None):
     return dt, result
 
 
-def merge_cache_leg(cfg, ids, x, required) -> tuple[dict, dict]:
+def merge_cache_leg(cfg, ids, x, required) -> tuple[dict, dict, dict]:
     """Merge-cache + merge-tree truth for the bench artifact: ONE
     persistent engine, trigger twice over an unchanged window (cold miss +
     exact hit), then a small top-up and a third trigger (dirty-subset delta
@@ -125,7 +125,7 @@ def merge_cache_leg(cfg, ids, x, required) -> tuple[dict, dict]:
     mc = st["merge_cache"]
     total = mc["hits"] + mc["misses"]
     mc["hit_rate"] = round(mc["hits"] / total, 3) if total else 0.0
-    return mc, st.get("merge_tree", {})
+    return mc, st.get("merge_tree", {}), st.get("flush_cascade", {})
 
 
 def serve_leg(d: int, algo: str) -> dict:
@@ -348,12 +348,13 @@ def child_main(backend: str) -> None:
     else:
         serve = {"skipped": True}
     try:
-        merge_cache, merge_tree = merge_cache_leg(
+        merge_cache, merge_tree, flush_cascade = merge_cache_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
         )
     except Exception as e:  # pragma: no cover - diagnostic path
         merge_cache = {"error": f"{type(e).__name__}: {e}"}
         merge_tree = {"error": f"{type(e).__name__}: {e}"}
+        flush_cascade = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -380,6 +381,7 @@ def child_main(backend: str) -> None:
                 "phase_breakdown_ms": phases,
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
+                "flush_cascade": flush_cascade,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }
         )
@@ -442,6 +444,7 @@ def _attach_last_tpu_run(result: dict) -> None:
                 "vs_baseline",
                 "p50_window_latency_ms",
                 "phase_breakdown_ms",
+                "flush_cascade",
                 # which measurement leg produced the recorded number (the
                 # round-5 measure script promotes the best of default /
                 # rank-on / overlap legs, which differ in config)

@@ -21,6 +21,12 @@ byte-identity asserted; the ``full`` leg above pins
 ``SKYLINE_MERGE_TREE=0`` so it stays the flat baseline). Writes
 ``artifacts/merge_tree_ab.json``.
 
+A fourth leg A/Bs the ISSUE-5 flush dominance cascade (quantized grid
+prefilter + bf16 margin pass) on vs off over identical streams: prime
+half, flush (publishes grid summaries), time the second-half flush each
+way, assert the global merges byte-identical, and report the drop
+fraction + flush-time delta. Writes ``artifacts/flush_prefilter_ab.json``.
+
 Usage: python benchmarks/merge_cache.py [--repeats 5] [--sizes ...]
 """
 
@@ -171,6 +177,73 @@ def bench_tree(n: int, d: int, P: int, repeats: int) -> dict:
     }
 
 
+def bench_prefilter(n: int, d: int, P: int, repeats: int) -> dict:
+    """Flush-cascade A/B (ISSUE-5 tentpole): grid prefilter + bf16 margin
+    pass on vs off over identical streams, byte-identical global merges
+    asserted. Primes half the stream (the first flush publishes the grid
+    summaries at its tail), then times the second-half flush — the shape
+    where the prefilter can actually drop rows before the merge kernels."""
+    from skyline_tpu.stream.batched import PartitionSet
+    from skyline_tpu.workload.generators import anti_correlated
+
+    def one_run(on: bool):
+        v = "1" if on else "0"
+        os.environ["SKYLINE_FLUSH_PREFILTER"] = v
+        os.environ["SKYLINE_MIXED_PRECISION"] = v
+        rng = np.random.default_rng(2)
+        x = anti_correlated(rng, n, d, 0, 10000).astype(np.float32)
+        pids = rng.integers(0, P, n)
+        pset = PartitionSet(P, d, buffer_size=max(n, 1024))
+        half = n // 2
+
+        def feed(lo, hi):
+            for p in range(P):
+                rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+                if rows.shape[0]:
+                    pset.add_batch(p, rows, max_id=n, now_ms=0.0)
+
+        feed(0, half)
+        pset.flush_all()
+        feed(half, n)
+        t0 = time.perf_counter()
+        pset.flush_all()
+        dt = (time.perf_counter() - t0) * 1000.0
+        return pset, dt
+
+    def leg(on: bool):
+        # fresh same-seed pset per repeat: a flush is one-shot, so the
+        # timed region can't be replayed in place; first run warms the
+        # executables and is discarded
+        times, pset = [], None
+        for i in range(repeats + 1):
+            pset, dt = one_run(on)
+            if i > 0:
+                times.append(dt)
+        return pset, float(np.median(times))
+
+    pset_off, off_ms = leg(on=False)
+    ref = pset_off.global_merge_stats(emit_points=True)
+    pset_on, on_ms = leg(on=True)
+    res = pset_on.global_merge_stats(emit_points=True)
+    assert res[2] == ref[2], (res[2], ref[2])
+    assert res[3].tobytes() == ref[3].tobytes(), (
+        f"prefilter cascade diverges from exact path at n={n} d={d}"
+    )
+    cs = pset_on.flush_cascade_stats()
+    return {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "skyline_size": int(ref[2]),
+        "off_flush_ms": round(off_ms, 2),
+        "on_flush_ms": round(on_ms, 2),
+        "flush_speedup": round(off_ms / on_ms, 2) if on_ms else None,
+        "prefilter_drop_fraction": round(cs["prefilter_drop_fraction"], 4),
+        "prefilter_dropped": cs["prefilter_dropped"],
+        "bf16_resolved": cs["bf16_resolved"],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--repeats", type=int, default=5)
@@ -179,6 +252,9 @@ def main(argv=None):
     ap.add_argument("--partitions", type=int, default=8)
     ap.add_argument("--out", default="artifacts/merge_cache_ab.json")
     ap.add_argument("--tree-out", default="artifacts/merge_tree_ab.json")
+    ap.add_argument(
+        "--prefilter-out", default="artifacts/flush_prefilter_ab.json"
+    )
     a = ap.parse_args(argv)
 
     import jax
@@ -191,7 +267,12 @@ def main(argv=None):
 
     prev = {
         k: os.environ.get(k)
-        for k in ("SKYLINE_MERGE_CACHE", "SKYLINE_MERGE_TREE")
+        for k in (
+            "SKYLINE_MERGE_CACHE",
+            "SKYLINE_MERGE_TREE",
+            "SKYLINE_FLUSH_PREFILTER",
+            "SKYLINE_MIXED_PRECISION",
+        )
     }
     results = {
         "backend": jax.default_backend(),
@@ -199,6 +280,11 @@ def main(argv=None):
         "rows": [],
     }
     tree_results = {
+        "backend": results["backend"],
+        "device": results["device"],
+        "rows": [],
+    }
+    prefilter_results = {
         "backend": results["backend"],
         "device": results["device"],
         "rows": [],
@@ -212,6 +298,9 @@ def main(argv=None):
                 trow = bench_tree(n, d, a.partitions, a.repeats)
                 print(json.dumps(trow), flush=True)
                 tree_results["rows"].append(trow)
+                prow = bench_prefilter(n, d, a.partitions, a.repeats)
+                print(json.dumps(prow), flush=True)
+                prefilter_results["rows"].append(prow)
     finally:
         for k, v in prev.items():
             if v is None:
@@ -226,6 +315,10 @@ def main(argv=None):
         os.makedirs(os.path.dirname(a.tree_out) or ".", exist_ok=True)
         with open(a.tree_out, "w") as f:
             json.dump(tree_results, f, indent=1)
+    if a.prefilter_out:
+        os.makedirs(os.path.dirname(a.prefilter_out) or ".", exist_ok=True)
+        with open(a.prefilter_out, "w") as f:
+            json.dump(prefilter_results, f, indent=1)
     return 0
 
 

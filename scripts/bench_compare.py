@@ -11,6 +11,7 @@ non-zero when any regresses past ``--threshold`` (default 25%):
   serve.read_p50_ms      serve read p50    higher is a regression
   serve.read_p99_ms      serve read p99    higher is a regression
   merge_cache.hit_rate   merge-cache leg   lower is a regression
+  flush_cascade.prefilter_drop_fraction    lower is a regression
 
 A metric missing from either artifact (e.g. the serve leg was skipped) is
 reported as ``skipped`` and never fails the gate. Runs on different
@@ -70,6 +71,12 @@ METRICS = (
     # prefilter stopped dropping partitions (dead summaries / gating bug)
     ("merge_tree.pruned_fraction", ("merge_tree", "pruned_fraction"),
      True, False),
+    # flush-cascade leg: the grid prefilter's drop fraction going to ~0
+    # means the quantized summaries stopped certifying drops (stale grid /
+    # validation disabling every dim / gating bug) — deterministic on any
+    # backend, so not tpu-only
+    ("flush_cascade.prefilter_drop_fraction",
+     ("flush_cascade", "prefilter_drop_fraction"), True, False),
     # merge-kernel share of the profiled window (computed, lower better):
     # the headline the pruned tree + tile skip are accountable for. Only
     # gated on real-TPU artifacts — on the cpu-fallback the phase mix is

@@ -127,7 +127,13 @@ try:
                  # tournament-tree merge (dims 3 > 2, so the tree ran and
                  # registered its series even if nothing got pruned)
                  "skyline_merge_tree_levels_total",
-                 "skyline_merge_partitions_pruned_total"):
+                 "skyline_merge_partitions_pruned_total",
+                 # flush cascade (dims 3 > 2, so the grid prefilter ran at
+                 # flush and registered its series even with zero drops;
+                 # bf16_resolved registers whenever mixed precision is on
+                 # and /stats above harvested the device counter)
+                 "skyline_flush_prefilter_dropped_total",
+                 "skyline_flush_bf16_resolved_total"):
         assert want in body, f"{want} missing from exposition"
     with urllib.request.urlopen(f"{serve_base}/metrics", timeout=5) as r:
         serve_body = r.read().decode()
@@ -195,6 +201,46 @@ assert digests["1"] == digests["0"], \
     "prune on/off merge results diverge (g or point bytes differ)"
 print(f"[obs-smoke] prune digest ok: g={digests['1'][0]} identical "
       "with SKYLINE_MERGE_PRUNE=1 and =0")
+EOF
+
+# flush dominance cascade: the quantized grid prefilter + bf16 margin pass
+# must not change a single output byte — run an identical TWO-round flush
+# stream (round 1 publishes the grid summaries the round-2 prefilter uses)
+# with the cascade on and off and compare global-merge digests
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.workload.generators import anti_correlated
+
+os.environ["SKYLINE_MERGE_CACHE"] = "0"
+digests = {}
+dropped = {}
+for on in ("1", "0"):
+    os.environ["SKYLINE_FLUSH_PREFILTER"] = on
+    os.environ["SKYLINE_MIXED_PRECISION"] = on
+    rng = np.random.default_rng(23)
+    pset = PartitionSet(4, 4)
+    x = anti_correlated(rng, 4000, 4, 0, 10000).astype(np.float32)
+    pids = rng.integers(0, 4, len(x))
+    half = len(x) // 2
+    for lo, hi in ((0, half), (half, len(x))):
+        for p in range(4):
+            rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+            if rows.shape[0]:
+                pset.add_batch(p, rows, max_id=len(x), now_ms=0.0)
+        pset.flush_all()
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    digests[on] = (int(g), np.asarray(surv).tobytes(), pts.tobytes())
+    dropped[on] = pset.flush_cascade_stats()["prefilter_dropped"]
+assert digests["1"] == digests["0"], \
+    "cascade on/off merge results diverge (g or point bytes differ)"
+assert dropped["1"] > 0, "prefilter dropped nothing — cascade not live"
+assert dropped["0"] == 0, dropped
+print(f"[obs-smoke] flush cascade digest ok: g={digests['1'][0]} identical "
+      f"with cascade on ({dropped['1']} rows prefiltered) and off")
 EOF
 
 # regression gate: newest two artifacts must currently pass at default

@@ -91,17 +91,17 @@ def main() -> None:
 
     # warm
     s, c = fresh()
-    s, c = sfs_round(s, c, jnp.asarray(blocks[0]), bv, active)
+    s, c, _ = sfs_round(s, c, jnp.asarray(blocks[0]), bv, active)
     np.asarray(c)
     s, c = fresh()
     t0 = time.perf_counter()
     for blk in blocks:
-        s, c = sfs_round(s, c, jnp.asarray(blk), bv, active)
+        s, c, _ = sfs_round(s, c, jnp.asarray(blk), bv, active)
     np.asarray(c)
     loop8 = time.perf_counter() - t0
     s, c = fresh()
     t0 = time.perf_counter()
-    s, c = sfs_round(s, c, jnp.asarray(blocks[0]), bv, active)
+    s, c, _ = sfs_round(s, c, jnp.asarray(blocks[0]), bv, active)
     np.asarray(c)
     single_r = time.perf_counter() - t0
     print(

@@ -75,6 +75,9 @@ async function tick() {
       ["merge cache misses", s.merge_cache && s.merge_cache.misses],
       ["delta merges", s.merge_cache && s.merge_cache.delta_merges],
       ["dirty fraction", s.merge_cache && s.merge_cache.last_dirty_fraction],
+      ["prefilter dropped", s.flush_cascade && s.flush_cascade.prefilter_dropped],
+      ["prefilter drop frac", s.flush_cascade && s.flush_cascade.prefilter_drop_fraction],
+      ["bf16 resolved", s.flush_cascade && s.flush_cascade.bf16_resolved],
     ].filter(([, v]) => v !== undefined && v !== null);
     document.getElementById("tiles").innerHTML = tiles.map(
       ([k, v]) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`

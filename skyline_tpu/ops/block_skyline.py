@@ -38,8 +38,18 @@ from skyline_tpu.ops.dominance import (
     PAD_VALUE,
     dominated_by,
     skyline_mask,
+    strictly_dominated_bf16,
 )
 from skyline_tpu.utils.buckets import next_pow2
+
+# Dominator-prefix length for the bf16 margin pre-pass of the scan
+# fallbacks (mirrors ops/sfs._MP_PREFIX): victims certified strictly
+# dominated by one of the first _MP_PREFIX dominator rows are final before
+# the chunk scan runs, and their sums drop out of the victim_max bound so
+# more dominator chunks clear the sum-skip. Certification is a proof of
+# f32 dominance (ops/dominance.strictly_dominated_bf16), so OR-ing it into
+# the scan verdict is bit-exact.
+_MP_PREFIX = 512
 
 
 def _sum_sort(x: jax.Array, valid: jax.Array):
@@ -109,8 +119,13 @@ def skyline_mask_blocked(x: jax.Array, valid: jax.Array | None = None, block: in
     return keep[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
-def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int = 0):
+@functools.partial(jax.jit, static_argnames=("chunk", "mp"))
+def skyline_mask_scan(
+    x: jax.Array,
+    valid: jax.Array | None = None,
+    chunk: int = 0,
+    mp: bool = False,
+):
     """Survivor mask via a LINEAR scan of dominator chunks against all columns.
 
     Same O(N^2 d) comparisons as the dense/blocked kernels but organized as
@@ -120,6 +135,11 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
     (see artifacts/kernels_tpu.json for the measured scan-vs-blocked-vs-
     Pallas table). Peak per-step memory is one (chunk, N) bool tile, so
     ``chunk`` shrinks automatically as N grows.
+
+    ``mp`` (static) prepends the bf16 margin pass: rows certified strictly
+    dominated by a short dominator prefix are final before the scan and
+    leave the victim_max bound, so more chunks clear the sum-skip. The
+    returned mask is bit-identical either way.
     """
     n, d = x.shape
     if valid is None:
@@ -138,6 +158,14 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
     rows = xp.reshape(nb, chunk, d)
     rvalid = vp.reshape(nb, chunk)
 
+    if mp:
+        limit = min(padded, _MP_PREFIX)
+        certified = vp & strictly_dominated_bf16(
+            xp, xp[:limit], vp[:limit]
+        )
+    else:
+        certified = jnp.zeros((padded,), dtype=bool)
+
     # Sum-bound chunk skip (same argument as pallas_dominance._tile_sum_skip:
     # f32 addition is monotone, so a dominator's sum never exceeds its
     # victim's). A chunk whose smallest valid-row sum beats every valid
@@ -145,9 +173,11 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
     # (chunk, N) tile at runtime (the scan is not vmapped). All-padding
     # chunks — capacity-bucket overshoot — always skip. Skipped chunks leave
     # invalid positions undominated, which `& vp` masks identically.
+    # Certified victims drop out of the bound: a chunk only able to
+    # dominate them is skippable because their verdict is already final.
     sums = jnp.where(vp, jnp.sum(xp, axis=-1), jnp.inf)
     chunk_min = jnp.min(sums.reshape(nb, chunk), axis=1)
-    victim_max = jnp.max(jnp.where(vp, sums, -jnp.inf))
+    victim_max = jnp.max(jnp.where(vp & ~certified, sums, -jnp.inf))
 
     def step(dom, blk):
         rx, rv, mn = blk
@@ -161,16 +191,17 @@ def skyline_mask_scan(x: jax.Array, valid: jax.Array | None = None, chunk: int =
 
     dom0 = jnp.zeros((padded,), dtype=bool)
     dom, _ = lax.scan(step, dom0, (rows, rvalid, chunk_min))
-    return (~dom & vp)[:n]
+    return (~(dom | certified) & vp)[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@functools.partial(jax.jit, static_argnames=("block", "mp"))
 def dominated_by_blocked(
     y: jax.Array,
     x: jax.Array,
     x_valid: jax.Array | None = None,
     block: int = 8192,
     y_valid: jax.Array | None = None,
+    mp: bool = False,
 ) -> jax.Array:
     """Like ``dominated_by`` but scans dominator set ``x`` in ``block``-row
     chunks so the pairwise tile never exceeds (len(y), block). Used for the
@@ -182,12 +213,22 @@ def dominated_by_blocked(
     Passing ``y_valid`` tightens that bound to valid victims only — then
     positions with ``y_valid`` False may be reported undominated where the
     dense op would say dominated; callers must mask the result by victim
-    validity (every call site in this repo already does)."""
+    validity (every call site in this repo already does). ``mp`` (static)
+    prepends the bf16 margin pass over a short dominator prefix; certified
+    victims are final (OR-ed into the result) and leave the victim_max
+    bound — bit-identical either way."""
     n, d = x.shape
     if y.shape[0] == 0:
         return jnp.zeros((0,), dtype=bool)
     if x_valid is None:
         x_valid = jnp.ones((n,), dtype=bool)
+    if mp:
+        limit = min(n, _MP_PREFIX)
+        certified = strictly_dominated_bf16(y, x[:limit], x_valid[:limit])
+        if y_valid is not None:
+            certified = certified & y_valid
+    else:
+        certified = jnp.zeros((y.shape[0],), dtype=bool)
     nb = -(-n // block)
     padded = nb * block
     if padded != n:
@@ -204,6 +245,7 @@ def dominated_by_blocked(
     ysums = jnp.sum(y, axis=-1)
     if y_valid is not None:
         ysums = jnp.where(y_valid, ysums, -jnp.inf)
+    ysums = jnp.where(certified, -jnp.inf, ysums)
     victim_max = jnp.max(ysums)
 
     def step(dom, chunk):
@@ -218,7 +260,7 @@ def dominated_by_blocked(
 
     dom0 = jnp.zeros((y.shape[0],), dtype=bool)
     dom, _ = lax.scan(step, dom0, (xb, vb, chunk_min))
-    return dom
+    return dom | certified
 
 
 @functools.partial(jax.jit, static_argnames=("out_cap",))
@@ -230,6 +272,7 @@ def skyline_large(
     x: np.ndarray,
     block: int = 0,
     dense_threshold: int = 8192,
+    mp: bool | None = None,
 ) -> np.ndarray:
     """Exact skyline of an (N, d) numpy window: host sum-sort, device-side
     append-only SFS rounds (``ops.sfs.sfs_round_single``, Pallas kernels on
@@ -256,10 +299,16 @@ def skyline_large(
     windows, block self-prune cost grows only linearly in B); on CPU it
     stays at 8192 so the dense (block x active) dominance mask stays
     bounded.
+
+    ``mp=None`` reads ``SKYLINE_MIXED_PRECISION`` per call (host-side, so
+    flipping the env really switches executables); True/False pin the
+    bf16-first cascade on/off. The result is bit-identical either way.
     """
-    from skyline_tpu.ops.dispatch import on_tpu
+    from skyline_tpu.ops.dispatch import mixed_precision_enabled, on_tpu
     from skyline_tpu.ops.sfs import sfs_round_single
 
+    if mp is None:
+        mp = mixed_precision_enabled()
     x = np.ascontiguousarray(x, dtype=np.float32)
     n, d = x.shape
     if n == 0:
@@ -303,8 +352,8 @@ def skyline_large(
         else:
             ub = rnd * block  # rows streamed so far bound the count
         active = min(cap, next_pow2(max(ub, 1), min_cap=1024))
-        sky, count = sfs_round_single(
-            sky, count, jnp.asarray(blk), jnp.asarray(bvalid), active
+        sky, count, _ = sfs_round_single(
+            sky, count, jnp.asarray(blk), jnp.asarray(bvalid), active, mp
         )
         counts.append(count)
 

@@ -111,6 +111,46 @@ def merge_prune_enabled() -> bool:
     return os.environ.get("SKYLINE_MERGE_PRUNE", "1") != "0"
 
 
+def flush_prefilter_enabled() -> bool:
+    """``SKYLINE_FLUSH_PREFILTER`` gates the quantized grid prefilter ahead
+    of the flush merge path (``stream/batched.py``): each partition keeps a
+    device-computed grid summary of its resident skyline (per-dim boundary
+    ladder + representative-cell codes, refreshed async at flush tails), and
+    incoming batch rows whose cell is strictly dominated by a representative
+    cell are dropped on the host before any merge kernel launches — an
+    O(B·C) byte-compare pass with C ≪ S. Sound by construction: a cell-level
+    strict dominance certificate implies strict f32 dominance (see RUNBOOK
+    §2g), and a stale summary only under-drops (skyline evolution preserves
+    transitive dominators). Default ON; set ``0`` for the exact-only
+    baseline (byte-identical output, asserted in tests/test_flush_cascade.py
+    and scripts/obs_smoke.sh). Read lazily per flush."""
+    import os
+
+    return os.environ.get("SKYLINE_FLUSH_PREFILTER", "1") != "0"
+
+
+def mixed_precision_enabled() -> bool:
+    """``SKYLINE_MIXED_PRECISION`` gates the bf16 margin pass inside the
+    flush dominance kernels (``ops/sfs.py``, ``ops/pallas_dominance.py``,
+    ``stream/window.py`` merge steps): pairs decided OUTSIDE an explicit
+    bf16 error margin are final (bf16 runs at ~2× VPU throughput), only
+    ambiguous pairs re-run in f32, so the result is bit-exact vs the pure
+    f32 kernels (margin-correctness argument in RUNBOOK §2g). Default: ON
+    on TPU, OFF elsewhere — XLA's CPU backend EMULATES bf16 (upcast +
+    round-trip per op), which turns the "cheap" margin pass into a ~4×
+    merge-kernel pessimization on the fallback (measured at n=128K 8D:
+    6.1s → 23.1s). An explicit ``SKYLINE_MIXED_PRECISION=0``/``1`` always
+    wins, on any backend. Threaded as a static jit argument from the flush
+    orchestration, so flipping it per-call really switches executables
+    (unlike trace-time env reads)."""
+    import os
+
+    v = os.environ.get("SKYLINE_MIXED_PRECISION")
+    if v is not None and v != "":
+        return v != "0"
+    return on_tpu()
+
+
 def query_overlap_enabled() -> bool:
     """``SKYLINE_QUERY_OVERLAP`` gates the overlapped query sync in
     ``stream/engine.py``: a trigger launches the global merge and returns

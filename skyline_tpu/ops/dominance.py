@@ -70,6 +70,47 @@ def dominated_by(y: jax.Array, x: jax.Array, x_valid: jax.Array | None = None) -
     return jnp.any(dom, axis=0)
 
 
+# bf16 margin for the mixed-precision first pass (ISSUE 5 stage 2). bf16
+# round-to-nearest has unit roundoff u = 2^-8 (8-bit significand with the
+# hidden bit); a pair comparison sees both operands' representation error,
+# bounded by u/(1-u) < 2^-7.9 of each bf16 magnitude. _BF16_EPS = 2^-7
+# strictly exceeds that combined bound (the margin arithmetic itself runs
+# in f32 on exactly-converted bf16 values, so its own 2^-24 roundoff is
+# absorbed by the slack); _BF16_TINY covers denormal absolute error near
+# zero. An over-wide margin only reclassifies decided pairs as ambiguous
+# (they re-run in f32) — it can never flip a certified verdict, which is
+# why the cascade is bit-exact (RUNBOOK §2g).
+_BF16_EPS = 2.0 ** -7
+_BF16_TINY = 1e-30
+
+
+def strictly_dominated_bf16(
+    y: jax.Array, x: jax.Array, x_valid: jax.Array | None = None
+) -> jax.Array:
+    """For each point in ``y``: is it CERTAINLY strictly dominated (strict
+    in every dimension) by some valid point in ``x``, certified from bf16
+    values with an explicit error margin?
+
+    y: (M, d) candidates; x: (N, d) dominators; x_valid: (N,) or None.
+    Returns (M,) bool. True is a proof of f32 strict dominance (the margin
+    exceeds the worst-case bf16 representation error of both operands);
+    False means "unknown", never "certainly not" — callers must re-check
+    False rows exactly. NaN rows and +inf-vs-+inf pairs compare False on
+    every margin test, so they are never certified (conservative).
+    """
+    xb = x.astype(jnp.bfloat16).astype(jnp.float32)
+    yb = y.astype(jnp.bfloat16).astype(jnp.float32)
+    margin = (
+        _BF16_EPS * (jnp.abs(xb)[:, None, :] + jnp.abs(yb)[None, :, :])
+        + _BF16_TINY
+    )
+    lt = (yb[None, :, :] - xb[:, None, :]) > margin  # (N, M, d)
+    dom = jnp.all(lt, axis=-1)
+    if x_valid is not None:
+        dom = dom & x_valid[:, None]
+    return jnp.any(dom, axis=0)
+
+
 def skyline_mask(x: jax.Array, valid: jax.Array | None = None) -> jax.Array:
     """Survivor mask of a point set: ``out[j]`` = x[j] is valid and non-dominated.
 

@@ -68,6 +68,68 @@ def _dom_tile(d: int, x_ref, y_ref, v_ref):
     return (mx <= 0.0) & (mn < 0.0) & vmask
 
 
+# bf16 margin for the in-kernel mixed-precision first pass (ISSUE 5 stage
+# 2). Wider than ops/dominance._BF16_EPS because here the margin and the
+# differences are themselves computed in bf16: 2^-6 is 4x the ~2^-7.9
+# combined representation-error bound, absorbing the extra rounding of the
+# bf16 margin arithmetic with slack to spare. Over-wide margins only send
+# more pairs to the f32 recheck — they can never flip a certified verdict,
+# so the kernel stays bit-exact (RUNBOOK §2g).
+_BF16_K_EPS = 0.015625  # 2^-6
+_BF16_K_TINY = 1e-30
+
+
+def _dom_tile_mp(d: int, x_ref, y_ref, v_ref):
+    """bf16 trilean classification of one (R, C) tile: returns
+    ``(certain, undecided)`` where ``certain[i, j]`` certifies f32 STRICT
+    dominance (every dim below the margin band) and ``undecided[i, j]``
+    marks pairs inside the band in some dim with no dim certainly greater —
+    only those need the f32 recheck. Pairs with a certainly-greater dim are
+    final non-dominators (x_k > y_k in f32 kills all(<=)). All compares run
+    in bf16 (~2x VPU throughput vs f32). NaN coords fail every margin test
+    -> undecided -> f32 recheck (conservative); +inf dominator rows get
+    diff = +inf > margin -> certainly-greater -> decided inert."""
+    bf = jnp.bfloat16
+    xb = x_ref[0, :].astype(bf)[:, None]
+    yb = y_ref[0, :].astype(bf)[None, :]
+    m = _BF16_K_EPS * (jnp.abs(xb) + jnp.abs(yb)) + _BF16_K_TINY
+    diff = xb - yb
+    all_lt = diff < -m
+    any_gt = diff > m
+    for k in range(1, d):  # static unroll over dimensions
+        xb = x_ref[k, :].astype(bf)[:, None]
+        yb = y_ref[k, :].astype(bf)[None, :]
+        m = _BF16_K_EPS * (jnp.abs(xb) + jnp.abs(yb)) + _BF16_K_TINY
+        dk = xb - yb
+        all_lt = all_lt & (dk < -m)
+        any_gt = any_gt | (dk > m)
+    vmask = v_ref[0, :][:, None] > 0.5
+    certain = all_lt & vmask
+    undecided = jnp.logical_not(all_lt | any_gt) & vmask
+    return certain, undecided
+
+
+def _tile_body(d: int, mp: bool, x_ref, y_ref, v_ref, out_ref):
+    """Shared compute body of the value-cascade kernels: with ``mp`` the
+    bf16 margin pass decides the tile first and the f32 cascade reruns only
+    when some pair lands inside the margin band. Exact either way: a fully
+    decided tile's certain set IS the f32 dominator set (decided-false
+    pairs have a strictly-greater dim), and an ambiguous tile ORs in the
+    full f32 verdict (a superset of its certain pairs)."""
+    if mp:
+        certain, undecided = _dom_tile_mp(d, x_ref, y_ref, v_ref)
+        out_ref[...] = out_ref[...] | certain.any(axis=0, keepdims=True)
+
+        @pl.when(undecided.any())
+        def _exact():
+            dom = _dom_tile(d, x_ref, y_ref, v_ref)
+            out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+    else:
+        dom = _dom_tile(d, x_ref, y_ref, v_ref)
+        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+
+
 def _tile_sum_skip(d: int, x_ref, y_ref, v_ref):
     """Sum-bound early exit for one (R, C) tile: if the smallest coordinate
     sum among VALID dominator rows exceeds the largest victim sum, no pair in
@@ -100,12 +162,13 @@ def _tile_rank_skip(d: int, x_ref, y_ref, v_ref):
     return jnp.min(sx) >= jnp.max(y_ref[d, :])
 
 
-def _kernel_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
+def _kernel_tri(d: int, rt: int, ct: int, mp: bool, x_ref, v_ref, y_ref, out_ref):
     """Triangular variant: inputs are pre-sorted by coordinate sum ascending,
     so a row (dominator) tile strictly after the column (victim) tile in sort
     order can never dominate — the whole tile is skipped. Halves the work of
     the self-skyline case. Surviving tiles still pass the data-dependent
-    sum-bound check (``_tile_sum_skip``) before paying the O(R*C*d) body.
+    sum-bound check (``_tile_sum_skip``) before paying the O(R*C*d) body
+    (bf16-first when ``mp``, see ``_tile_body``).
 
     Padding note: +inf pad rows produce diff = inf - y = inf -> mx = inf,
     never <= 0, so padding stays dominance-neutral; inf - inf = nan
@@ -120,11 +183,10 @@ def _kernel_tri(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
     def _compute():
         @pl.when(jnp.logical_not(_tile_sum_skip(d, x_ref, y_ref, v_ref)))
         def _body():
-            dom = _dom_tile(d, x_ref, y_ref, v_ref)
-            out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+            _tile_body(d, mp, x_ref, y_ref, v_ref, out_ref)
 
 
-def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
+def _kernel(d: int, rt: int, ct: int, mp: bool, x_ref, v_ref, y_ref, out_ref):
     # x_ref: (d, R) dominator coords; v_ref: (1, R) dominator validity as
     # float32 (Mosaic can't reshape 1-bit vectors across the minor dim);
     # y_ref: (d, C) victim coords; out_ref: (1, C) accumulated dominated flags
@@ -136,8 +198,7 @@ def _kernel(d: int, rt: int, ct: int, x_ref, v_ref, y_ref, out_ref):
 
     @pl.when(jnp.logical_not(_tile_sum_skip(d, x_ref, y_ref, v_ref)))
     def _compute():
-        dom = _dom_tile(d, x_ref, y_ref, v_ref)
-        out_ref[...] = out_ref[...] | dom.any(axis=0, keepdims=True)
+        _tile_body(d, mp, x_ref, y_ref, v_ref, out_ref)
 
 
 def _dom_tile_rank(d: int, x_ref, y_ref, v_ref):
@@ -284,7 +345,8 @@ def dominated_by_rank_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("triangular", "interpret", "row_tile", "col_tile")
+    jax.jit,
+    static_argnames=("triangular", "interpret", "row_tile", "col_tile", "mp"),
 )
 def dominated_by_any_pallas(
     xt: jax.Array,
@@ -293,6 +355,7 @@ def dominated_by_any_pallas(
     interpret: bool = False,
     row_tile: int = ROW_TILE,
     col_tile: int = COL_TILE,
+    mp: bool = False,
 ) -> jax.Array:
     """dominated[j] = any valid i dominates j, over one transposed set.
 
@@ -301,6 +364,8 @@ def dominated_by_any_pallas(
     which handles padding. Self-pairs are safe (a point never dominates
     itself) and padding columns never dominate (+inf is never <=).
     ``triangular=True`` requires rows sorted by coordinate sum ascending.
+    ``mp=True`` runs the bf16 margin pass first inside each tile (bit-exact,
+    see ``_tile_body``).
     """
     d, n = xt.shape
     # clamp tiles to the problem size (callers pad to >=1024-row buckets);
@@ -310,7 +375,7 @@ def dominated_by_any_pallas(
     v2 = valid[None, :].astype(jnp.float32)  # (1, N), 32-bit for Mosaic
     kern = _kernel_tri if triangular else _kernel
     out = pl.pallas_call(
-        functools.partial(kern, d, rt, ct),
+        functools.partial(kern, d, rt, ct, mp),
         grid=grid,
         in_specs=[
             pl.BlockSpec((d, rt), lambda j, i: (0, i)),  # dominators
@@ -325,7 +390,7 @@ def dominated_by_any_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile", "mp")
 )
 def dominated_by_pallas(
     xt: jax.Array,
@@ -334,12 +399,13 @@ def dominated_by_pallas(
     interpret: bool = False,
     row_tile: int = ROW_TILE,
     col_tile: int = COL_TILE,
+    mp: bool = False,
 ) -> jax.Array:
     """Rectangular variant: dominated[j] = any valid x_i dominates y_j.
 
     xt: (d, Nx) dominators (Nx % row_tile == 0); yt: (d, Ny) victims
     (Ny % col_tile == 0). The streaming flush's batch-vs-skyline prune maps
-    here directly.
+    here directly. ``mp=True`` enables the in-tile bf16 first pass.
     """
     d, nx = xt.shape
     _, ny = yt.shape
@@ -347,7 +413,7 @@ def dominated_by_pallas(
     grid = (ny // ct, nx // rt)
     v2 = x_valid[None, :].astype(jnp.float32)
     out = pl.pallas_call(
-        functools.partial(_kernel, d, rt, ct),
+        functools.partial(_kernel, d, rt, ct, mp),
         grid=grid,
         in_specs=[
             pl.BlockSpec((d, rt), lambda j, i: (0, i)),
@@ -362,7 +428,7 @@ def dominated_by_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "row_tile", "col_tile")
+    jax.jit, static_argnames=("interpret", "row_tile", "col_tile", "mp")
 )
 def skyline_mask_pallas(
     x: jax.Array,
@@ -370,12 +436,14 @@ def skyline_mask_pallas(
     interpret: bool = False,
     row_tile: int = ROW_TILE,
     col_tile: int = COL_TILE,
+    mp: bool = False,
 ) -> jax.Array:
     """Survivor mask over (N, d) points via the Pallas dominance kernel.
 
     Semantically identical to ``skyline_mask`` / ``skyline_mask_scan``;
     pads N up to a tile multiple internally, sum-sorts to exploit the
-    triangular skip, and unsorts the result.
+    triangular skip, and unsorts the result. ``mp=True`` enables the
+    in-tile bf16 first pass (bit-exact).
     """
     n, d = x.shape
     if valid is None:
@@ -400,6 +468,7 @@ def skyline_mask_pallas(
         interpret=interpret,
         row_tile=row_tile,
         col_tile=col_tile,
+        mp=mp,
     )
     keep_sorted = ~dominated & vs
     return keep_sorted[inv][:n]

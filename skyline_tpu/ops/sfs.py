@@ -33,7 +33,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from skyline_tpu.ops.dispatch import on_tpu
-from skyline_tpu.ops.dominance import compact, dominated_by, skyline_mask
+from skyline_tpu.ops.dominance import (
+    compact,
+    dominated_by,
+    skyline_mask,
+    strictly_dominated_bf16,
+)
+
+# Dominator-prefix length for the row-level bf16 pre-drop (mixed-precision
+# stage 2): block rows certainly strictly-dominated by one of the first
+# _MP_PREFIX skyline rows are dropped (masked to +inf) before the exact
+# kernels. Sum-sorted skylines put the strongest dominators first, so a
+# short prefix catches most dominated rows at O(B·prefix) cost — the full
+# exact pass over survivors keeps the result bit-identical regardless.
+_MP_PREFIX = 512
 
 
 def pallas_interpret() -> bool:
@@ -46,7 +59,7 @@ def pallas_interpret() -> bool:
     return os.environ.get("SKYLINE_PALLAS_INTERPRET", "") == "1"
 
 
-def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
+def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp, mp=False):
     """One SFS append round for one partition.
 
     sky: (cap, d) buffer whose first ``count`` rows are a skyline; block:
@@ -56,12 +69,37 @@ def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
     dominator prefix actually compared against — the capacity bucket of the
     current max count, so early rounds don't pay full-capacity passes.
 
+    ``mp`` (static) enables the mixed-precision stage-2 pass: a bf16 margin
+    pre-drop of block rows certainly strictly-dominated by a skyline prefix
+    row (counted in the third return), plus the in-kernel bf16 first pass
+    of the Pallas tri-kernels. Bit-exact vs ``mp=False``: a certified drop
+    implies the exact sky-vs-block pass drops the row too, and any block
+    row it would itself have pruned is strictly dominated by the same sky
+    row (transitivity), so the survivor set and the stable compact order
+    are unchanged. Returns ``(sky, count, resolved)``; ``resolved`` is the
+    int32 count of bf16-certified drops (0 when ``mp=False``).
+
     Caller guarantees count + B <= cap (the compacted block writes B slots;
     rows past the survivor count are +inf padding landing on virgin rows).
     """
     cap, d = sky.shape
     sky_act = lax.slice(sky, (0, 0), (active, d))
     sky_ok = jnp.arange(active) < count
+    resolved = jnp.zeros((), dtype=jnp.int32)
+    if mp:
+        limit = min(active, _MP_PREFIX)
+        pre = strictly_dominated_bf16(
+            block,
+            lax.slice(sky, (0, 0), (limit, d)),
+            jnp.arange(limit) < count,
+        )
+        pre = pre & bvalid
+        resolved = jnp.sum(pre, dtype=jnp.int32)
+        bvalid = bvalid & ~pre
+        # +inf'd rows stay sum-sort-compatible for the triangular skip (a
+        # replaced row only moves UP in sum, and its own column's verdict
+        # is masked out by bvalid)
+        block = jnp.where(bvalid[:, None], block, jnp.inf)
     if use_pallas:
         from skyline_tpu.ops.pallas_dominance import (
             dominated_by_any_pallas,
@@ -70,50 +108,53 @@ def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp):
 
         block_t = block.T
         keep = bvalid & ~dominated_by_any_pallas(
-            block_t, bvalid, triangular=True, interpret=interp
+            block_t, bvalid, triangular=True, interpret=interp, mp=mp
         )
         keep = keep & ~dominated_by_pallas(
-            sky_act.T, sky_ok, block_t, interpret=interp
+            sky_act.T, sky_ok, block_t, interpret=interp, mp=mp
         )
     else:
         keep = skyline_mask(block, bvalid)
         keep = keep & ~dominated_by(block, sky_act, x_valid=sky_ok)
     vals, _, m = compact(block, keep, block.shape[0])
     sky = lax.dynamic_update_slice(sky, vals, (count, 0))
-    return sky, count + m
+    return sky, count + m, resolved
 
 
 @functools.partial(
-    jax.jit, static_argnames=("active",), donate_argnums=(0,)
+    jax.jit, static_argnames=("active", "mp"), donate_argnums=(0,)
 )
-def sfs_round(sky, counts, blocks, bvalids, active: int):
+def sfs_round(sky, counts, blocks, bvalids, active: int, mp: bool = False):
     """Vmapped SFS round over all partitions: sky (P, cap, d), counts (P,)
-    int32, blocks (P, B, d), bvalids (P, B) -> (sky', counts'). One device
-    launch for the whole set — right when partitions carry comparable row
-    counts (every vmap lane computes the full (B x active) passes whether
-    its block is real or padding; see ``sfs_round_single`` for the skewed
-    case)."""
+    int32, blocks (P, B, d), bvalids (P, B) -> (sky', counts', resolved
+    (P,)). One device launch for the whole set — right when partitions
+    carry comparable row counts (every vmap lane computes the full
+    (B x active) passes whether its block is real or padding; see
+    ``sfs_round_single`` for the skewed case). ``mp`` (static) threads the
+    mixed-precision pass — a jit cache key, so flipping the env gate
+    really switches executables."""
     use_pallas = on_tpu()
     interp = pallas_interpret()
 
     def core(s, c, b, bv):
-        return sfs_round_core(s, c, b, bv, active, use_pallas, interp)
+        return sfs_round_core(s, c, b, bv, active, use_pallas, interp, mp)
 
     return jax.vmap(core)(sky, counts, blocks, bvalids)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("active",), donate_argnums=(0,)
+    jax.jit, static_argnames=("active", "mp"), donate_argnums=(0,)
 )
-def sfs_round_single(sky_p, count, block, bvalid, active: int):
+def sfs_round_single(sky_p, count, block, bvalid, active: int, mp: bool = False):
     """One partition's SFS round without the vmap lane dimension: sky_p
     (cap, d), count () int32, block (B, d), bvalid (B,). Under routing skew
     (one or two partitions holding most of the stream — mr-angle at 8D
     anti-correlated routes ~96% of rows to 2 of 8 partitions) the vmapped
     round pays P lanes of (B x active) work for one real lane; processing
-    the heavy partitions individually costs exactly their own rows."""
+    the heavy partitions individually costs exactly their own rows.
+    Returns (sky', count', resolved)."""
     return sfs_round_core(
-        sky_p, count, block, bvalid, active, on_tpu(), pallas_interpret()
+        sky_p, count, block, bvalid, active, on_tpu(), pallas_interpret(), mp
     )
 
 

@@ -35,14 +35,17 @@ import numpy as np
 from skyline_tpu.metrics.tracing import NULL_TRACER
 from skyline_tpu.ops.dispatch import (
     delta_dirty_cutoff,
+    flush_prefilter_enabled,
     flush_stage_depth,
     merge_cache_enabled,
     merge_prune_enabled,
     merge_tree_enabled,
+    mixed_precision_enabled,
     on_tpu,
 )
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
+    GRID_BINS,
     _MIN_CAP,
     _active_bucket,
     _next_pow2,
@@ -51,6 +54,7 @@ from skyline_tpu.stream.window import (
     global_merge_delta_device,
     global_merge_stats_device,
     global_points_device,
+    grid_summary_device,
     merge_step_active,
     meshed_merge_step,
     meshed_sfs_cleanup,
@@ -77,6 +81,11 @@ _PROBE_B = 8192
 # Device-ingest chunks are split/padded to power-of-two buckets capped here,
 # bounding the set of ingest executables.
 _CHUNK_BUCKET_MAX = 65536
+
+# Host chunk for the grid-prefilter cell coding: the (chunk, GRID_REPS, d)
+# comparison broadcast stays ~10 MB at 8D instead of scaling with the
+# whole pending window.
+_PREFILTER_CHUNK = 16384
 
 
 class _MergeHandle:
@@ -289,6 +298,24 @@ class PartitionSet:
         self.merge_tree_merges = 0
         self.merge_partitions_pruned = 0
         self.last_tree_info: dict | None = None
+        # quantized-grid flush prefilter (ISSUE 5 stage 1): the device
+        # handle pair (bounds, rep cell codes) launched async at flush
+        # tails; the validated host copy is harvested lazily at the next
+        # flush. Stale summaries are sound (a removed skyline row always
+        # leaves a transitive strict dominator behind — _prefilter_rows),
+        # but restore replaces the world and must invalidate.
+        self._grid_dev = None
+        self._grid_host = None
+        self._grid_epoch: np.ndarray | None = None
+        self.prefilter_dropped = 0
+        self.prefilter_seen = 0
+        # mixed-precision stage 2: running device scalar of bf16-resolved
+        # pair counts (one tiny add per flush round, synced only on the
+        # stats path) + the high-water mark already fed to the telemetry
+        # counters (flush_cascade_stats delta-feeds them)
+        self._mp_resolved_dev = None
+        self._bf16_resolved_reported = 0
+        self.bf16_resolved = 0
         # a deferred (async-started) count-bound tighten from the last lazy
         # flush, consumed by the next sky_counts()/global merge
         self._tighten_pending = False
@@ -559,9 +586,11 @@ class PartitionSet:
         if total == 0:
             return
         t0 = time.perf_counter_ns()
+        mp = mixed_precision_enabled()
         self._bump_epoch(self._pending_rows > 0)
         with self.tracer.phase("flush/assemble"):
             rows = self._drain_pending()
+        rows = self._prefilter_rows(rows)
 
         max_rows = max(r.shape[0] for r in rows)
         # one common power-of-two batch bucket B; partitions with more than B
@@ -605,9 +634,10 @@ class PartitionSet:
                     # partition axis (each device merges only its resident
                     # partitions)
                     merge = meshed_merge_step(
-                        self.mesh, self.mesh.axis_names[0], on_tpu(), out_cap
+                        self.mesh, self.mesh.axis_names[0], on_tpu(), out_cap,
+                        mp,
                     )
-                    self.sky, self.sky_valid, self._count_dev = merge(
+                    self.sky, self.sky_valid, self._count_dev, res = merge(
                         self.sky, self.sky_valid, batch_dev, bvalid_dev
                     )
                 else:
@@ -620,7 +650,7 @@ class PartitionSet:
                         self._cap,
                         _active_bucket(max(int(self._count_ub.max()), 1)),
                     )
-                    self.sky, self.sky_valid, self._count_dev = (
+                    self.sky, self.sky_valid, self._count_dev, res = (
                         merge_step_active(
                             self.sky,
                             self.sky_valid,
@@ -628,8 +658,11 @@ class PartitionSet:
                             bvalid_dev,
                             active,
                             grow,
+                            mp,
                         )
                     )
+                if mp:
+                    self._accum_resolved(res)
                 if self.tracer.sync_device:
                     # profiling mode: attribute the async kernel here instead
                     # of at whichever later phase forces the sync. A host
@@ -644,6 +677,7 @@ class PartitionSet:
         self._counts_cache = None
         self._host_cache = None
         self._maybe_launch_summaries()
+        self._maybe_launch_grid()
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _sfs_vmapped(self, rows: list[np.ndarray], max_rows: int):
@@ -654,6 +688,7 @@ class PartitionSet:
         # save dispatches (at B^2/2 self-prune cost per round)
         B = _next_pow2(min(max_rows, max(self.buffer_size, 8192)))
         n_rounds = -(-max_rows // B)
+        mp = mixed_precision_enabled()
         counts = self._count_dev
         # lag-2 tightening: the rows-streamed bound on _count_ub grows
         # linearly, but the true skyline may stay tiny (uniform/correlated
@@ -698,15 +733,18 @@ class PartitionSet:
             with self.tracer.phase("flush/merge_kernel"):
                 if self.mesh is not None:
                     rnd_fn = meshed_sfs_round(
-                        self.mesh, self.mesh.axis_names[0], on_tpu(), active
+                        self.mesh, self.mesh.axis_names[0], on_tpu(), active,
+                        mp,
                     )
-                    self.sky, counts = rnd_fn(
+                    self.sky, counts, res = rnd_fn(
                         self.sky, counts, batch_dev, bvalid_dev
                     )
                 else:
-                    self.sky, counts = sfs_round(
-                        self.sky, counts, batch_dev, bvalid_dev, active
+                    self.sky, counts, res = sfs_round(
+                        self.sky, counts, batch_dev, bvalid_dev, active, mp
                     )
+                if mp:
+                    self._accum_resolved(res)
                 if self.tracer.sync_device:
                     np.asarray(counts)
             prev.append((counts, widths))
@@ -766,6 +804,7 @@ class PartitionSet:
             counts_host = np.zeros(self.num_partitions, dtype=np.int64)
         else:
             counts_host = self.sky_counts().astype(np.int64)
+        mp = mixed_precision_enabled()
         row_counts = np.array([r.shape[0] for r in rows], dtype=np.int64)
 
         # capacity grows ON DEMAND as survivor counts actually grow (one
@@ -829,9 +868,11 @@ class PartitionSet:
                         block_dev = jnp.asarray(block)
                         bvalid_dev = jnp.asarray(bvalid)
                     with self.tracer.phase("flush/merge_kernel"):
-                        sky_p, cnt_p = sfs_round_single(
-                            sky_p, cnt_p, block_dev, bvalid_dev, active
+                        sky_p, cnt_p, res = sfs_round_single(
+                            sky_p, cnt_p, block_dev, bvalid_dev, active, mp
                         )
+                        if mp:
+                            self._accum_resolved(res)
                         if self.tracer.sync_device:
                             np.asarray(cnt_p)
                     prev.append((cnt_p, w))
@@ -861,6 +902,7 @@ class PartitionSet:
             counts_host = self._count_ub.copy()
         else:
             counts_host = self.sky_counts().astype(np.int64)
+        mp = mixed_precision_enabled()
         widths = np.diff(bounds)
         # blocks sliced from the sorted window must fit its SORT_TAIL pad
         # (a dynamic_slice past the buffer clamps backward and desyncs the
@@ -910,9 +952,12 @@ class PartitionSet:
                                 off, w, B=B, active=active,
                             )
                         else:
-                            sky_p, cnt_p = dw.sfs_round_at(
-                                sky_p, cnt_p, ws, off, w, B=B, active=active
+                            sky_p, cnt_p, res = dw.sfs_round_at(
+                                sky_p, cnt_p, ws, off, w,
+                                B=B, active=active, mp=mp,
                             )
+                            if mp:
+                                self._accum_resolved(res)
                         if self.tracer.sync_device:
                             np.asarray(cnt_p)
                     prev.append((cnt_p, w))
@@ -934,6 +979,162 @@ class PartitionSet:
         self.sky = self._put(jnp.concatenate([self.sky, pad], axis=1))
         self._cap = new_cap
 
+    # -- flush dominance cascade (grid prefilter + mixed precision) ---------
+
+    def _accum_resolved(self, res) -> None:
+        """Fold one round's bf16-resolved counts into the running device
+        scalar — a tiny async add, synced only by ``flush_cascade_stats``
+        (never on the flush hot path)."""
+        s = jnp.sum(res, dtype=jnp.int32)
+        self._mp_resolved_dev = (
+            s if self._mp_resolved_dev is None else self._mp_resolved_dev + s
+        )
+
+    def _prefilter_on(self) -> bool:
+        """Grid prefilter liveness for this set: single device, ``dims >
+        2`` (the d <= 2 sweep flush has no merge kernels to save), gate
+        env read per flush."""
+        return (
+            self.mesh is None and self.dims > 2 and flush_prefilter_enabled()
+        )
+
+    def _maybe_launch_grid(self) -> None:
+        """Flush-tail hook (both host-row flush paths): start the grid
+        summary compute for the state just flushed, async, so the NEXT
+        flush's prefilter reads landed bytes instead of syncing cold."""
+        if not self._prefilter_on():
+            return
+        active = min(
+            self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
+        )
+        self._grid_dev = grid_summary_device(
+            self.sky, self._count_dev, active
+        )
+        for a in self._grid_dev:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+        self._grid_host = None
+        self._grid_epoch = self._epoch.copy()
+
+    def _grid_summaries(self):
+        """Validated host copy of the launched grid summary, or ``None``
+        when no summary exists yet. Host-side validation disables (per
+        partition x dim) any boundary ladder f32 rounding failed to keep
+        strictly increasing — codes against a non-monotone ladder could
+        certify false dominance; a disabled dim never certifies, which
+        disables drops for its whole partition (the certificate needs
+        every dim). Empty partitions produce NaN ladders and disable
+        everything — zero drops, conservative."""
+        if self._grid_dev is None:
+            return None
+        if self._grid_host is None:
+            bounds = np.asarray(self._grid_dev[0])
+            ux = np.asarray(self._grid_dev[1]).copy()
+            with np.errstate(invalid="ignore"):  # NaN ladder = empty part.
+                bad = ~np.all(np.diff(bounds, axis=1) > 0, axis=1)  # (P, d)
+            if bad.any():
+                ux[np.broadcast_to(bad[:, None, :], ux.shape)] = GRID_BINS + 1
+            self._grid_host = (bounds, ux)
+        return self._grid_host
+
+    def _prefilter_rows(self, rows: list[np.ndarray]) -> list[np.ndarray]:
+        """Stage 1 of the flush cascade: drop pending rows whose grid cell
+        is strictly dominated by a representative cell of their partition's
+        resident skyline — an O(B·C) integer-compare pass before any merge
+        kernel launches (C = GRID_REPS ≪ S resident rows).
+
+        Soundness: a row y coded ``vy`` and a representative x coded ``ux``
+        with ``ux < vy`` in EVERY dim satisfy
+        ``x <= bounds[ux] < bounds[vy] <= y`` per-dim (the ladder is
+        validated strictly increasing), i.e. x strictly dominates y. x was
+        a LIVE skyline row when the summary launched; if a later flush
+        removed it, its remover chain ends at a current row that still
+        strictly dominates y (each removal step only tightens every
+        coordinate), so the exact merge drops y anyway — and any pending
+        row y itself would have pruned is strictly dominated by the same
+        chain (transitivity). Survivor set AND compaction/append order are
+        therefore byte-identical with the prefilter on or off
+        (tests/test_flush_cascade.py asserts this). NaN rows code to -1
+        and are never dropped; +inf rows code to GRID_BINS and may drop
+        (legitimately — a finite representative strictly dominates +inf).
+        """
+        if not self._prefilter_on():
+            return rows
+        grid = self._grid_summaries()
+        seen = int(sum(r.shape[0] for r in rows))
+        dropped = 0
+        if grid is not None and seen:
+            bounds, ux = grid
+            with self.tracer.phase("flush/prefilter"):
+                for p, r in enumerate(rows):
+                    n = r.shape[0]
+                    if n == 0:
+                        continue
+                    b = bounds[p]  # (GRID_BINS+1, d) boundary ladder
+                    u = ux[p]  # (R, d) representative cell codes
+                    if not (u <= GRID_BINS).all(axis=1).any():
+                        continue  # no representative can certify here
+                    keep = np.ones(n, dtype=bool)
+                    any_drop = False
+                    for s in range(0, n, _PREFILTER_CHUNK):
+                        c = np.asarray(
+                            r[s : s + _PREFILTER_CHUNK], np.float32
+                        )
+                        # vy = largest ladder index with bounds[vy] <= y
+                        # (NaN compares false everywhere -> vy = -1)
+                        vy = (
+                            b[None, :, :] <= c[:, None, :]
+                        ).sum(axis=1, dtype=np.int32) - 1  # (m, d)
+                        drop = np.any(
+                            np.all(u[None, :, :] < vy[:, None, :], axis=2),
+                            axis=1,
+                        )
+                        if drop.any():
+                            keep[s : s + c.shape[0]] = ~drop
+                            any_drop = True
+                    if any_drop:
+                        dropped += int(n - keep.sum())
+                        rows[p] = r[keep]
+        self.prefilter_seen += seen
+        self.prefilter_dropped += dropped
+        # inc 0 too: the Prometheus series must register at the first
+        # prefiltered flush, not the first nonzero drop (obs_smoke asserts
+        # presence right after one flush+stats round trip)
+        self._inc("flush.prefilter_dropped", dropped)
+        # register unconditionally: the series must exist even where mixed
+        # precision defaults off (CPU-fallback), so scrapers see a stable
+        # schema and obs_smoke can assert both series on any backend
+        self._inc("flush.bf16_resolved", 0)
+        return rows
+
+    def flush_cascade_stats(self) -> dict:
+        """Flush-cascade observability block (stage-1 grid-prefilter
+        counters, host-exact, plus the stage-2 bf16-resolved device
+        accumulator). The device scalar is synced HERE — stats/bench
+        paths only, the flush hot path never blocks on it — and the total
+        is delta-fed to the telemetry counters so /metrics and this dict
+        always agree."""
+        if self._mp_resolved_dev is not None:
+            total = int(np.asarray(self._mp_resolved_dev))
+            self.bf16_resolved = total
+            delta = total - self._bf16_resolved_reported
+            if delta:
+                self._inc("flush.bf16_resolved", delta)
+                self._bf16_resolved_reported = total
+        seen = self.prefilter_seen
+        return {
+            "prefilter_enabled": self._prefilter_on(),
+            "mixed_precision": mixed_precision_enabled(),
+            "prefilter_seen": seen,
+            "prefilter_dropped": self.prefilter_dropped,
+            "prefilter_drop_fraction": (
+                self.prefilter_dropped / seen if seen else 0.0
+            ),
+            "bf16_resolved": self.bf16_resolved,
+        }
+
     def _flush_lazy(self) -> None:
         """Lazy-policy flush: sum-sort each partition's accumulated window
         and stream it through append-only SFS rounds — one vmapped launch
@@ -943,6 +1144,11 @@ class PartitionSet:
         self._bump_epoch(self._pending_rows > 0)
         with self.tracer.phase("flush/assemble"):
             rows = self._drain_pending()
+        # prefilter BEFORE the sum sort: dropped rows skip the sort too,
+        # and a stable sort of the surviving subset keeps the same relative
+        # order the post-sort drop would (byte-identical SFS appends)
+        rows = self._prefilter_rows(rows)
+        with self.tracer.phase("flush/assemble"):
             for p, r in enumerate(rows):
                 if r.shape[0] > 1:
                     order = np.argsort(r.sum(axis=1), kind="stable")
@@ -1040,6 +1246,7 @@ class PartitionSet:
                 pass
             self._tighten_pending = True
         self._maybe_launch_summaries()
+        self._maybe_launch_grid()
         self.processing_ns += time.perf_counter_ns() - t0
 
     def _flush_sweep(self) -> None:
@@ -1238,6 +1445,7 @@ class PartitionSet:
             dw.SORT_TAIL,
         )
         n_rounds = -(-max_rows // B)
+        mp = mixed_precision_enabled()
         counts = self._count_dev
         lo = bounds[:-1]
         hi = bounds[1:]
@@ -1269,10 +1477,12 @@ class PartitionSet:
                         offs_d, w_d, B=B, active=active,
                     )
                 else:
-                    self.sky, counts = dw.sfs_round_at_vmapped(
+                    self.sky, counts, res = dw.sfs_round_at_vmapped(
                         self.sky, counts, ws, offs_d, w_d,
-                        B=B, active=active,
+                        B=B, active=active, mp=mp,
                     )
+                    if mp:
+                        self._accum_resolved(res)
                 if self.tracer.sync_device:
                     np.asarray(counts)
             prev.append((counts, w))
@@ -1778,6 +1988,12 @@ class PartitionSet:
         # merge cached against the pre-restore state can never be reused
         self._epoch += 1
         self._gm_cache = None
+        # the grid prefilter summary described the replaced skylines; the
+        # staleness argument (_prefilter_rows) covers EVOLVED state, not a
+        # swapped world, so it must go
+        self._grid_dev = None
+        self._grid_host = None
+        self._grid_epoch = None
         self._tighten_pending = False
         for p, pending in enumerate(pendings):
             if pending.shape[0]:

@@ -317,28 +317,31 @@ def sfs_cleanup_rank(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+    jax.jit, static_argnames=("B", "active", "mp"), donate_argnums=(0,)
 )
-def sfs_round_at(sky_p, count, win, off, width, *, B: int, active: int):
+def sfs_round_at(sky_p, count, win, off, width, *, B: int, active: int, mp: bool = False):
     """One partition's SFS round reading its block out of the sorted device
     window: block = win[off : off + B], valid rows = first ``width``.
     The tail rows of a partition's final block belong to the NEXT partition
     in the sorted order — masked to +inf so they are inert as dominators
     and never appended. Drop-in device-window twin of
-    ``ops.sfs.sfs_round_single``."""
+    ``ops.sfs.sfs_round_single`` — ``mp`` (static) threads the
+    mixed-precision pass and the bf16-resolved count rides third."""
     d = win.shape[1]
     block = lax.dynamic_slice(win, (off, jnp.zeros((), jnp.int32)), (B, d))
     bvalid = jnp.arange(B) < width
     block = jnp.where(bvalid[:, None], block, jnp.inf)
     return sfs_round_core(
-        sky_p, count, block, bvalid, active, on_tpu(), pallas_interpret()
+        sky_p, count, block, bvalid, active, on_tpu(), pallas_interpret(), mp
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("B", "active"), donate_argnums=(0,)
+    jax.jit, static_argnames=("B", "active", "mp"), donate_argnums=(0,)
 )
-def sfs_round_at_vmapped(sky, counts, win, offs, widths, *, B: int, active: int):
+def sfs_round_at_vmapped(
+    sky, counts, win, offs, widths, *, B: int, active: int, mp: bool = False
+):
     """Vmapped ``sfs_round_at`` over all partitions (sky (P, cap, d),
     offs/widths (P,)) — one launch per round for balanced loads, each lane
     slicing its own block from the shared sorted window."""
@@ -352,6 +355,8 @@ def sfs_round_at_vmapped(sky, counts, win, offs, widths, *, B: int, active: int)
         )
         bvalid = jnp.arange(B) < width
         block = jnp.where(bvalid[:, None], block, jnp.inf)
-        return sfs_round_core(s, c, block, bvalid, active, use_pallas, interp)
+        return sfs_round_core(
+            s, c, block, bvalid, active, use_pallas, interp, mp
+        )
 
     return jax.vmap(core)(sky, counts, offs, widths)

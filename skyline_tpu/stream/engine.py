@@ -774,6 +774,7 @@ class SkylineEngine:
                     "candidates_per_level", []
                 ),
             },
+            "flush_cascade": self.pset.flush_cascade_stats(),
         }
         if include_skyline_counts:
             out["partitions"]["skyline_counts"] = (

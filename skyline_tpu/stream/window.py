@@ -23,9 +23,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from skyline_tpu.ops.dominance import compact, dominated_by, skyline_mask
+from skyline_tpu.ops.dominance import (
+    compact,
+    dominated_by,
+    skyline_mask,
+    strictly_dominated_bf16,
+)
 from skyline_tpu.ops.sfs import (  # noqa: F401  (re-exported: the SFS
-    pallas_interpret as _pallas_interpret,  # kernels moved to the ops layer)
+    _MP_PREFIX,  # kernels moved to the ops layer)
+    pallas_interpret as _pallas_interpret,
     sfs_cleanup,
     sfs_round,
     sfs_round_single,
@@ -91,7 +97,30 @@ def _active_bucket(n: int) -> int:
     return p
 
 
-def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
+def _mp_predrop(sky, sky_valid, batch, batch_valid):
+    """bf16-margin pre-drop of batch rows certainly strictly-dominated by a
+    skyline prefix row (mixed-precision stage 2, shared by both merge cores).
+
+    Bit-exact vs skipping it: a certified row y has a valid sky dominator x
+    with x < y strictly in every dim, so the exact sky-vs-batch pass drops y
+    anyway, and any batch row q that y would have pruned from the
+    batch-local pass satisfies x < y <= q per-dim — x strictly dominates q
+    too (transitivity), so q is dropped by the sky pass either way. Masking
+    y to +inf only moves its coordinate sum UP, so sum-sorted invariants of
+    callers are preserved. Returns (batch', batch_valid', resolved)."""
+    limit = min(sky.shape[0], _MP_PREFIX)
+    d = sky.shape[1]
+    pre = strictly_dominated_bf16(
+        batch, lax.slice(sky, (0, 0), (limit, d)), sky_valid[:limit]
+    )
+    pre = pre & batch_valid
+    resolved = jnp.sum(pre, dtype=jnp.int32)
+    batch_valid = batch_valid & ~pre
+    batch = jnp.where(batch_valid[:, None], batch, jnp.inf)
+    return batch, batch_valid, resolved
+
+
+def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int, mp: bool = False):
     """One windowed-BNL step: merge a new batch into a running skyline and
     compact survivors into a fresh ``out_cap`` buffer.
 
@@ -104,40 +133,56 @@ def _merge_step_core(sky, sky_valid, batch, batch_valid, out_cap: int):
       (a dropped batch dominator's own dominator chain ends at a kept point
       that also dominates the victim, so kept batch points suffice).
 
-    Returns (values (out_cap, d), valid (out_cap,), count). ``out_cap`` must
-    be >= current survivor count + batch rows, so overflow cannot occur.
+    ``mp`` (static) enables the bf16 margin pre-drop (``_mp_predrop``) —
+    bit-exact either way. Returns (values (out_cap, d), valid (out_cap,),
+    count, resolved); ``resolved`` is the int32 count of bf16-certified
+    drops (0 when ``mp=False``). ``out_cap`` must be >= current survivor
+    count + batch rows, so overflow cannot occur.
     """
+    resolved = jnp.zeros((), dtype=jnp.int32)
+    if mp:
+        batch, batch_valid, resolved = _mp_predrop(
+            sky, sky_valid, batch, batch_valid
+        )
     batch_local = skyline_mask(batch, batch_valid)
     keep_batch = batch_local & ~dominated_by(batch, sky, x_valid=sky_valid)
     keep_sky = sky_valid & ~dominated_by(sky, batch, x_valid=keep_batch)
     x = jnp.concatenate([sky, batch], axis=0)
     keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
-    return compact(x, keep, out_cap)
+    vals, valid, cnt = compact(x, keep, out_cap)
+    return vals, valid, cnt, resolved
 
 
-def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int):
+def _merge_step_pallas_core(sky, sky_valid, batch, batch_valid, out_cap: int, mp: bool = False):
     """TPU fast path of ``_merge_step_core``: the three dominance passes run
     in the Pallas VMEM-tiled kernel (same mask logic, same transitivity
-    arguments). Requires sky/batch extents to be tile multiples — the
+    arguments; ``mp`` additionally threads the in-kernel bf16 first pass).
+    Requires sky/batch extents to be tile multiples — the
     _MIN_CAP floor plus pow2 capacities / pow2-or-tile-multiple active
     prefixes (``_active_bucket``) guarantee that."""
     from skyline_tpu.ops.pallas_dominance import dominated_by_pallas
 
     interp = _pallas_interpret()
+    resolved = jnp.zeros((), dtype=jnp.int32)
+    if mp:
+        batch, batch_valid, resolved = _mp_predrop(
+            sky, sky_valid, batch, batch_valid
+        )
     sky_t = sky.T
     batch_t = batch.T
     batch_local = batch_valid & ~dominated_by_pallas(
-        batch_t, batch_valid, batch_t, interpret=interp
+        batch_t, batch_valid, batch_t, interpret=interp, mp=mp
     )
     keep_batch = batch_local & ~dominated_by_pallas(
-        sky_t, sky_valid, batch_t, interpret=interp
+        sky_t, sky_valid, batch_t, interpret=interp, mp=mp
     )
     keep_sky = sky_valid & ~dominated_by_pallas(
-        batch_t, keep_batch, sky_t, interpret=interp
+        batch_t, keep_batch, sky_t, interpret=interp, mp=mp
     )
     x = jnp.concatenate([sky, batch], axis=0)
     keep = jnp.concatenate([keep_sky, keep_batch], axis=0)
-    return compact(x, keep, out_cap)
+    vals, valid, cnt = compact(x, keep, out_cap)
+    return vals, valid, cnt, resolved
 
 
 # Batched merge: P partitions' flushes in ONE device launch
@@ -157,10 +202,12 @@ _merge_step_pallas_batched = jax.jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("active", "out_active"),
+    static_argnames=("active", "out_active", "mp"),
     donate_argnums=(0, 1),
 )
-def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: int):
+def merge_step_active(
+    sky, sky_valid, batch, bvalid, active: int, out_active: int, mp: bool = False
+):
     """Incremental flush step over the ACTIVE capacity prefix only.
 
     A pre-sized or previously-grown buffer makes the plain batched merge pay
@@ -182,6 +229,9 @@ def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: in
     rounds (out_cap > cap) can't reuse the buffer and fall back to a fresh
     allocation with jax's "donated buffers not usable" warning (filtered in
     tests/conftest.py, log-bounded in production by the doubling schedule).
+
+    ``mp`` (static, a jit cache key) threads the bf16 margin pass; the
+    fourth return is the per-partition bf16-resolved count (P,) int32.
     """
     from skyline_tpu.ops.dispatch import on_tpu
 
@@ -189,8 +239,8 @@ def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: in
     core = _merge_step_pallas_core if on_tpu() else _merge_step_core
     sky_a = lax.slice(sky, (0, 0, 0), (P, active, d))
     val_a = lax.slice(sky_valid, (0, 0), (P, active))
-    vals, valid, cnt = jax.vmap(
-        lambda s, sv, b, bv: core(s, sv, b, bv, out_active)
+    vals, valid, cnt, res = jax.vmap(
+        lambda s, sv, b, bv: core(s, sv, b, bv, out_active, mp)
     )(sky_a, val_a, batch, bvalid)
     out_cap = max(cap, out_active)
     if out_active < out_cap:
@@ -204,7 +254,7 @@ def merge_step_active(sky, sky_valid, batch, bvalid, active: int, out_active: in
         valid = jnp.concatenate(
             [valid, jnp.zeros((P, out_cap - out_active), dtype=bool)], axis=1
         )
-    return vals, valid, cnt.astype(jnp.int32)
+    return vals, valid, cnt.astype(jnp.int32), res
 
 
 @functools.partial(jax.jit, static_argnames=("active", "union_cap"))
@@ -405,6 +455,67 @@ def partition_summaries_device(sky, counts, active: int):
     )
 
 
+# Quantized-grid flush prefilter (ISSUE 5 stage 1). GRID_BINS boundary
+# steps per dimension; GRID_REPS representative skyline rows per partition.
+# The summary is tiny — (P, BINS+1, d) f32 boundaries + (P, REPS, d) int32
+# cell codes — so the flush-tail transfer is a few KB against the multi-MB
+# skylines it summarizes.
+GRID_BINS = 32
+GRID_REPS = 64
+
+
+@functools.partial(jax.jit, static_argnames=("active",))
+def grid_summary_device(sky, counts, active: int):
+    """Per-partition quantized grid summary for the flush prefilter:
+    ``(bounds (P, GRID_BINS+1, d) f32, ux (P, R, d) int32)`` with
+    R = min(active, GRID_REPS).
+
+    ``bounds[p, :, k]`` is an explicit ascending boundary ladder
+    ``lo + i*step`` over dimension ``k``'s finite live range — shipped to
+    the host verbatim, so host and device quantize against the SAME f32
+    values (no cross-platform arithmetic-identity assumptions). ``ux`` are
+    the representatives' cell codes: ``ux = #(bounds < x)``, the smallest
+    index with ``x <= bounds[ux]``. The host codes an incoming row y as
+    ``vy = #(bounds <= y) - 1`` (largest index with ``bounds[vy] <= y``)
+    and drops y iff ``ux < vy`` in EVERY dim: then
+    ``x <= bounds[ux] < bounds[vy] <= y`` strictly per-dim (the host
+    validates the ladder is strictly increasing and disables dims where
+    f32 rounding collapsed it), i.e. the representative — an actual live
+    skyline row — strictly dominates y, so the exact merge would drop y
+    too (stage-1 soundness, RUNBOOK §2g).
+
+    Representatives are the first R rows of the live prefix (sum-sorted
+    under the lazy/SFS policies, insertion-ordered under incremental —
+    soundness never depends on which rows are picked). Non-finite or
+    out-of-count representative rows are masked to code GRID_BINS+1, which
+    can never certify (vy <= GRID_BINS). Empty partitions produce NaN
+    ladders that fail host validation — zero drops, conservative."""
+    P, cap, d = sky.shape
+    s = lax.slice(sky, (0, 0, 0), (P, active, d))
+    valid = jnp.arange(active)[None, :] < counts[:, None]
+    finite = jnp.isfinite(s) & valid[:, :, None]
+    lo = jnp.min(jnp.where(finite, s, jnp.inf), axis=1)  # (P, d)
+    hi = jnp.max(jnp.where(finite, s, -jnp.inf), axis=1)
+    # step > 0 even for degenerate (single-value) dims, so the ladder is
+    # strictly increasing whenever lo is finite and the step survives f32
+    # addition (the host re-checks that)
+    step = jnp.maximum(
+        (hi - lo) / GRID_BINS, jnp.maximum(jnp.abs(lo), 1.0) * 1e-6
+    )
+    ladder = jnp.arange(GRID_BINS + 1, dtype=s.dtype)
+    bounds = lo[:, None, :] + ladder[None, :, None] * step[:, None, :]
+    r = min(active, GRID_REPS)
+    reps = lax.slice(s, (0, 0, 0), (P, r, d))
+    rep_ok = (jnp.arange(r)[None, :] < counts[:, None]) & jnp.all(
+        jnp.isfinite(reps), axis=2
+    )
+    ux = jnp.sum(
+        bounds[:, None, :, :] < reps[:, :, None, :], axis=2
+    ).astype(jnp.int32)
+    ux = jnp.where(rep_ok[:, :, None], ux, GRID_BINS + 1)
+    return bounds, ux
+
+
 @functools.partial(jax.jit, static_argnames=("p", "width"))
 def extract_sky_leaf(sky, counts, p: int, width: int):
     """One partition's live prefix as a tree leaf: (vals (width, d),
@@ -541,22 +652,25 @@ def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):
 
 
 @functools.lru_cache(maxsize=None)
-def meshed_merge_step(mesh, axis: str, use_pallas: bool, out_cap: int):
+def meshed_merge_step(mesh, axis: str, use_pallas: bool, out_cap: int, mp: bool = False):
     """Batched merge wrapped in ``shard_map`` over the partition axis
     (see ``_shard_map_vmapped``). Cached per (mesh, axis, kernel, capacity
-    bucket) so steady-state flushes reuse one executable."""
+    bucket, mixed-precision flag) so steady-state flushes reuse one
+    executable. Returns 4 outputs — the per-partition bf16-resolved counts
+    ride along (all-zero when ``mp=False``)."""
     core = _merge_step_pallas_core if use_pallas else _merge_step_core
     return _shard_map_vmapped(
-        mesh, axis, lambda s, sv, b, bv: core(s, sv, b, bv, out_cap), 4, 3
+        mesh, axis, lambda s, sv, b, bv: core(s, sv, b, bv, out_cap, mp), 4, 4
     )
 
 
 @functools.lru_cache(maxsize=None)
-def meshed_sfs_round(mesh, axis: str, use_pallas: bool, active: int):
+def meshed_sfs_round(mesh, axis: str, use_pallas: bool, active: int, mp: bool = False):
     """``sfs_round`` wrapped in ``shard_map`` over the partition axis (see
     ``_shard_map_vmapped``) — the lazy policy's meshed flush. Cached per
-    (mesh, axis, kernel, active bucket); donates the sky buffer like the
-    single-device jit."""
+    (mesh, axis, kernel, active bucket, mixed-precision flag); donates the
+    sky buffer like the single-device jit. Returns 3 outputs — per-partition
+    bf16-resolved counts third (all-zero when ``mp=False``)."""
     from skyline_tpu.ops.sfs import pallas_interpret, sfs_round_core
 
     interp = pallas_interpret()
@@ -564,10 +678,10 @@ def meshed_sfs_round(mesh, axis: str, use_pallas: bool, active: int):
         mesh,
         axis,
         lambda s, c, b, bv: sfs_round_core(
-            s, c, b, bv, active, use_pallas, interp
+            s, c, b, bv, active, use_pallas, interp, mp
         ),
         4,
-        2,
+        3,
         donate=(0,),
     )
 

@@ -32,6 +32,69 @@ _PROBE_SRC = (
 # Keyed on nothing: one verdict per process. ``cached: True`` marks reuse.
 _VERDICT: dict | None = None
 
+# Cross-process verdict cache: bench.py, obs_smoke.sh, and the benchmark
+# scripts each probe from a fresh interpreter, so on a dead tunnel every one
+# of them pays the full probe timeout. A successful verdict is persisted
+# under artifacts/ and reused until ``SKYLINE_PROBE_CACHE_TTL_S`` (seconds,
+# default 3600; 0 disables the file cache) expires. Only SUCCESSFUL probes
+# are persisted — a failure verdict must not outlive the process that saw
+# it, or a recovered tunnel would stay invisible for the whole TTL.
+_CACHE_FILE = "backend_probe_cache.json"
+
+
+def _cache_path() -> str:
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "..", "..", "artifacts", _CACHE_FILE)
+
+
+def probe_cache_ttl_s(default: float = 3600.0) -> float:
+    import os
+
+    v = os.environ.get("SKYLINE_PROBE_CACHE_TTL_S")
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            pass
+    return default
+
+
+def _load_file_verdict() -> dict | None:
+    """Fresh-enough persisted verdict, or None. Never raises."""
+    ttl = probe_cache_ttl_s()
+    if ttl <= 0:
+        return None
+    try:
+        with open(_cache_path()) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["ts"])
+        verdict = rec["verdict"]
+        if age < 0 or age >= ttl or verdict.get("backend") is None:
+            return None
+        verdict["cache_age_s"] = round(age, 1)
+        return verdict
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _store_file_verdict(diag: dict) -> None:
+    """Persist a successful verdict (atomic rename). Never raises."""
+    if probe_cache_ttl_s() <= 0 or diag.get("backend") is None:
+        return
+    import os
+
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "verdict": diag}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
 
 def probe_timeout_s(default: float = 150.0) -> float:
     """Resolve the probe timeout: ``SKYLINE_PROBE_TIMEOUT_S`` wins, then the
@@ -61,8 +124,12 @@ def probe_backend(
     ``probe_total_s`` covers the WHOLE call including failed attempts and
     backoff sleeps (``probe_s`` keeps its original meaning: the one
     successful attempt), so wasted probe time is visible in artifacts.
-    The verdict is cached for the process lifetime (``use_cache=False``
-    forces a re-probe).
+    The verdict is cached for the process lifetime AND — successes only —
+    persisted under artifacts/ for ``SKYLINE_PROBE_CACHE_TTL_S`` seconds so
+    sibling processes skip the subprocess too (``use_cache=False`` forces a
+    re-probe). Cache hits stamp provenance: ``probe_total_s`` becomes the
+    (near-zero) hit-serving time, the probed wall time moves to
+    ``probe_total_s_probed``, and ``cache_source`` says which cache hit.
     """
     import os
 
@@ -70,7 +137,19 @@ def probe_backend(
     if use_cache and _VERDICT is not None:
         out = dict(_VERDICT)
         out["cached"] = True
+        out["cache_source"] = "process"
+        out["probe_total_s_probed"] = out.get("probe_total_s")
+        out["probe_total_s"] = 0.0
         return out
+    if use_cache:
+        out = _load_file_verdict()
+        if out is not None:
+            _VERDICT = dict(out)  # pre-stamp: keeps the probed wall time
+            out["cached"] = True
+            out["cache_source"] = "file"
+            out["probe_total_s_probed"] = out.get("probe_total_s")
+            out["probe_total_s"] = 0.0
+            return out
     wall0 = time.time()
     diag: dict = {"attempts": 0, "errors": [], "n_devices": 0}
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
@@ -93,6 +172,7 @@ def probe_backend(
                     diag["probe_s"] = round(time.time() - t0, 1)
                     diag["probe_total_s"] = round(time.time() - wall0, 1)
                     _VERDICT = dict(diag)
+                    _store_file_verdict(diag)
                     return diag
                 except (ValueError, IndexError):
                     err = (

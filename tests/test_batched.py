@@ -137,7 +137,7 @@ def test_meshed_merge_pallas_interpret(rng, monkeypatch):
         batch[p, :50] = x
         bvalid[p, :50] = True
     merge = meshed_merge_step(mesh, mesh.axis_names[0], True, cap)
-    out_sky, out_valid, out_count = merge(
+    out_sky, out_valid, out_count, _ = merge(
         sky, sky_valid, jax.device_put(batch, sh), jax.device_put(bvalid, sh))
     out_sky = np.asarray(out_sky)
     counts = np.asarray(out_count)
@@ -204,11 +204,11 @@ def test_sfs_round_single_matches_vmapped(rng):
     for rnd in range(2):
         batch = np.stack([x[rnd * B:(rnd + 1) * B] for x in parts])
         bvalid = np.ones((P, B), dtype=bool)
-        sky_v, cnt_v = sfs_round(
+        sky_v, cnt_v, _ = sfs_round(
             sky_v, cnt_v, jnp.asarray(batch), jnp.asarray(bvalid), cap)
         singles = [
             sfs_round_single(s, c, jnp.asarray(batch[p]),
-                             jnp.asarray(bvalid[p]), cap)
+                             jnp.asarray(bvalid[p]), cap)[:2]
             for p, (s, c) in enumerate(singles)]
     cnt_v = np.asarray(cnt_v)
     for p, (s, c) in enumerate(singles):
