@@ -59,6 +59,8 @@ import subprocess
 import sys
 import time
 
+from skyline_tpu.analysis.registry import env_bool, env_float, env_int, env_str
+
 import numpy as np
 
 
@@ -73,6 +75,32 @@ def rank_cascade_stamp() -> bool:
     from skyline_tpu.ops.dispatch import rank_cascade
 
     return rank_cascade()
+
+
+def analysis_stamp() -> dict:
+    """Provenance of the static-analysis gate for the bench artifact: the
+    knob-registry size, per-rule finding counts over the product tree, and
+    the jaxpr audit matrix this run's dispatch variants were checked
+    against (RUNBOOK 2h). A non-empty ``rule_counts`` means the gate would
+    fail CI — perf numbers from such a tree carry an asterisk."""
+    from skyline_tpu.analysis.__main__ import default_roots, repo_root, run_passes
+    from skyline_tpu.analysis.registry import KNOBS
+
+    base = repo_root()
+    findings, summary = run_passes(("knobs", "locks", "jaxpr"), base)
+    rule_counts: dict[str, int] = {}
+    for f in findings:
+        rule_counts[f.rule] = rule_counts.get(f.rule, 0) + 1
+    jaxpr = summary.get("jaxpr", {})
+    return {
+        "registry_size": len(KNOBS),
+        "lint_roots": [os.path.relpath(r, base) for r in default_roots(base)],
+        "rule_counts": rule_counts,  # empty == gate clean
+        "findings_total": len(findings),
+        "jaxpr_configs_traced": jaxpr.get("configs_traced", 0),
+        "jaxpr_dims": jaxpr.get("dims", []),
+        "jaxpr_backend": jaxpr.get("backend"),
+    }
 
 
 # --------------------------------------------------------------------------
@@ -155,10 +183,10 @@ def serve_leg(d: int, algo: str) -> dict:
     from skyline_tpu.telemetry import Histogram
     from skyline_tpu.workload.generators import anti_correlated
 
-    n = int(os.environ.get("BENCH_SERVE_N", 65536))
-    readers = int(os.environ.get("BENCH_SERVE_READERS", 32))
-    reads_each = int(os.environ.get("BENCH_SERVE_READS", 25))
-    points = "1" if os.environ.get("BENCH_SERVE_POINTS") == "1" else "0"
+    n = env_int("BENCH_SERVE_N", 65536)
+    readers = env_int("BENCH_SERVE_READERS", 32)
+    reads_each = env_int("BENCH_SERVE_READS", 25)
+    points = "1" if env_bool("BENCH_SERVE_POINTS", False) else "0"
     rng = np.random.default_rng(1)
     eng = SkylineEngine(
         EngineConfig(parallelism=2, algo=algo, dims=d, domain_max=10000.0,
@@ -247,7 +275,7 @@ def child_main(backend: str) -> None:
     # survive across bench runs, collapsing the warmup window
     from skyline_tpu.utils.compile_cache import enable_compile_cache
 
-    enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE"))
+    enable_compile_cache(env_str("BENCH_COMPILE_CACHE"))
 
     default_n = 1_000_000
     # 5 measured windows: the remote-TPU link occasionally stalls a
@@ -259,12 +287,12 @@ def child_main(backend: str) -> None:
         # WITHIN the child timeout: the 8-D anti-correlated window is
         # O(N*S) on the CPU SFS path (~15 s at N=131072 after the round-3
         # lag-2/probe-block work), so size and window count shrink
-        default_n = int(os.environ.get("BENCH_CPU_N", 131072))
+        default_n = env_int("BENCH_CPU_N", 131072)
         default_windows = 1
-    n = int(os.environ.get("BENCH_N", default_n))
-    d = int(os.environ.get("BENCH_D", 8))
-    windows = int(os.environ.get("BENCH_WINDOWS", default_windows))
-    parallelism = int(os.environ.get("BENCH_PARALLELISM", 4))
+    n = env_int("BENCH_N", default_n)
+    d = env_int("BENCH_D", 8)
+    windows = env_int("BENCH_WINDOWS", default_windows)
+    parallelism = env_int("BENCH_PARALLELISM", 4)
 
     from skyline_tpu.stream import EngineConfig
     from skyline_tpu.workload.generators import anti_correlated
@@ -274,23 +302,23 @@ def child_main(backend: str) -> None:
     # mr-angle routes ~96% of rows to 2 of 8 partitions (stream/batched.py
     # skew notes), so a balanced partitioner can do several times less
     # local-phase dominance work for the same (invariant) result
-    algo = os.environ.get("BENCH_ALGO", "mr-angle")
+    algo = env_str("BENCH_ALGO", "mr-angle")
     cfg = EngineConfig(
         parallelism=parallelism,
         algo=algo,
         dims=d,
         domain_max=10000.0,
-        buffer_size=int(os.environ.get("BENCH_BUFFER", 8192)),
+        buffer_size=env_int("BENCH_BUFFER", 8192),
         # pre-size to the known steady-state local-skyline bucket for the
         # 8-D anti-correlated window (~57k/partition -> 64k bucket): skips
         # the per-window capacity-growth syncs/recompiles
-        initial_capacity=int(os.environ.get("BENCH_INITIAL_CAP", 65536)),
+        initial_capacity=env_int("BENCH_INITIAL_CAP", 65536),
         # lazy = sum-sorted append-only SFS at query time: a fraction of the
         # incremental policy's dominance work for the tumbling
         # window-then-query pattern (see stream/batched.py). Set
         # BENCH_FLUSH_POLICY=incremental to measure the streaming cadence,
         # =overlap for the transport-style chunked flushes.
-        flush_policy=os.environ.get("BENCH_FLUSH_POLICY", "lazy"),
+        flush_policy=env_str("BENCH_FLUSH_POLICY", "lazy"),
         # device ingest: pre-size the accumulation window to the known
         # window size (skips per-run growth reallocs/executables)
         window_capacity=n,
@@ -340,7 +368,7 @@ def child_main(backend: str) -> None:
     real_backend = jax.default_backend()
     # serving-plane leg: read-side latency + shed behavior (BENCH_SERVE=0
     # to skip). Never allowed to kill the ingest measurement above.
-    if os.environ.get("BENCH_SERVE", "1") != "0":
+    if env_bool("BENCH_SERVE", True):
         try:
             serve = serve_leg(d, algo)
         except Exception as e:  # pragma: no cover - diagnostic path
@@ -355,6 +383,10 @@ def child_main(backend: str) -> None:
         merge_cache = {"error": f"{type(e).__name__}: {e}"}
         merge_tree = {"error": f"{type(e).__name__}: {e}"}
         flush_cascade = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        analysis = analysis_stamp()
+    except Exception as e:  # pragma: no cover - diagnostic path
+        analysis = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -382,6 +414,7 @@ def child_main(backend: str) -> None:
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,
+                "analysis": analysis,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }
         )
@@ -496,15 +529,15 @@ def main() -> None:
     # SKYLINE_PROBE_TIMEOUT_S is the canonical knob (shared with the doctor
     # scripts); the legacy BENCH_PROBE_TIMEOUT still works underneath
     probe_timeout = probe_timeout_s(150.0)
-    probe_attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 2))
-    probe_backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 20))
-    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 3000))
-    tpu_attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
+    probe_attempts = env_int("BENCH_PROBE_ATTEMPTS", 2)
+    probe_backoff = env_float("BENCH_PROBE_BACKOFF", 20.0)
+    child_timeout = env_float("BENCH_CHILD_TIMEOUT", 3000.0)
+    tpu_attempts = env_int("BENCH_TPU_ATTEMPTS", 2)
     # a user-pinned JAX_PLATFORMS=cpu is the conventional JAX override and
     # implies the CPU path, same as BENCH_FORCE_CPU=1
     force_cpu = (
-        os.environ.get("BENCH_FORCE_CPU", "") == "1"
-        or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        env_bool("BENCH_FORCE_CPU", False)
+        or env_str("JAX_PLATFORMS", "") == "cpu"
     )
 
     errors: list[str] = []

@@ -34,6 +34,8 @@ import time
 
 import numpy as np
 
+from skyline_tpu.analysis.registry import env_str
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -327,7 +329,7 @@ def main() -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if env_str("JAX_PLATFORMS", "") == "cpu":
         # the env var alone does not stop the axon plugin from initializing
         # (and hanging when the tunnel is down); the config update does
         jax.config.update("jax_platforms", "cpu")

@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from skyline_tpu.analysis.registry import env_str
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -95,7 +97,7 @@ def main(argv=None):
     # belt and braces (same as run_configs.py): JAX_PLATFORMS=cpu alone has
     # been observed to still initialize the axon TPU plugin, which hangs
     # when the tunnel is down — the config update actually pins the backend
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if env_str("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
     results = {

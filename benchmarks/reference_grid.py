@@ -21,6 +21,8 @@ import sys
 
 import numpy as np
 
+from skyline_tpu.analysis.registry import env_str
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks._common import one_window
@@ -115,7 +117,7 @@ def main(argv=None):
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if env_str("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     from skyline_tpu.utils.compile_cache import enable_compile_cache
 

@@ -21,6 +21,8 @@ import time
 
 import numpy as np
 
+from skyline_tpu.analysis.registry import env_str
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks._common import CHUNK, one_window
@@ -130,7 +132,7 @@ def main(argv=None):
     # belt and braces with the env var: JAX_PLATFORMS=cpu alone has been
     # observed to still initialize the axon TPU plugin (which hangs when
     # the tunnel is down); the config update actually pins the backend
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
+    if env_str("JAX_PLATFORMS", "") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     from skyline_tpu.utils.compile_cache import enable_compile_cache
 

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static-analysis gate: the three-pass analyzer over the product tree.
+#   pass 1  knob lint      — every env read via the declared registry;
+#                            no dead/undeclared knobs, no ad-hoc truthiness
+#   pass 2  jaxpr audit    — trace the dispatch matrix, assert no f64 /
+#                            host callbacks / dynamic shapes, bf16 iff mp,
+#                            stable retrace + compile cache
+#   pass 3  lock lint      — guarded-by annotated state mutates only
+#                            inside its lock
+# plus the docs/KNOBS.md drift check. Exits non-zero on any error finding.
+#
+#   scripts/lint.sh            # all passes (CPU; the CI entry)
+#   scripts/lint.sh knobs,locks  # subset, skipping the jax import
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PASSES="${1:-knobs,jaxpr,locks}"
+
+JAX_PLATFORMS=cpu python -m skyline_tpu.analysis --pass "$PASSES"
+python -m skyline_tpu.analysis --check-doc
+echo "lint.sh: analysis gate clean (passes: $PASSES)"

@@ -46,10 +46,14 @@ def _honor_jax_platforms_env() -> None:
     backend is not yet initialized, and the pinned config disagrees,
     re-apply the env var (exactly what stock JAX would have done).
     """
-    import os
     import sys
 
-    want = os.environ.get("JAX_PLATFORMS")
+    # registry import stays inside the function: transport-only CLIs pay
+    # nothing extra, and the accessor keeps the knob lint's single-reader
+    # invariant airtight (JAX_PLATFORMS is declared external in KNOBS)
+    from skyline_tpu.analysis.registry import env_str
+
+    want = env_str("JAX_PLATFORMS")
     if not want:
         return
     # only repair when a plugin ALREADY imported jax at interpreter startup

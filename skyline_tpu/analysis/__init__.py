@@ -1,0 +1,38 @@
+"""Static-analysis subsystem: knob registry + CI lint passes.
+
+``registry`` (stdlib-only, import-light — the dispatch hot path and
+``skyline_tpu/__init__.py`` import it) declares every runtime knob and owns
+the sanctioned env accessors. The three analysis passes live in
+``knob_lint`` / ``jaxpr_audit`` / ``lock_lint`` and run together via
+``python -m skyline_tpu.analysis`` (see ``__main__.py``; wired into CI by
+``scripts/lint.sh`` and ``scripts/obs_smoke.sh``).
+
+Only the registry is re-exported here: importing the package must never
+pull in jax (the jaxpr auditor imports it lazily inside ``run``).
+"""
+
+from skyline_tpu.analysis.registry import (  # noqa: F401
+    KNOBS,
+    Knob,
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+    knob,
+    knob_doc_markdown,
+    knob_names,
+    parse_bool,
+)
+
+__all__ = [
+    "KNOBS",
+    "Knob",
+    "env_bool",
+    "env_float",
+    "env_int",
+    "env_str",
+    "knob",
+    "knob_doc_markdown",
+    "knob_names",
+    "parse_bool",
+]

@@ -1,0 +1,33 @@
+"""Shared finding record for the static-analysis passes.
+
+Every pass (knob lint, jaxpr audit, lock lint) reports the same shape:
+``file:line severity rule message`` — one line per finding, grep-able,
+stable enough for CI to diff. ``severity`` is ``error`` (fails the gate)
+or ``warn`` (printed, never fails).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+SEVERITIES = ("error", "warn")
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    severity: str
+    rule: str
+    message: str
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}")
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line} {self.severity} {self.rule} {self.message}"
+
+
+def errors(findings) -> list[Finding]:
+    return [f for f in findings if f.severity == "error"]

@@ -1,0 +1,405 @@
+"""Declarative runtime-knob registry — the single source of truth.
+
+Five perf PRs grew ~50 ``SKYLINE_*`` / ``BENCH_*`` environment knobs read
+ad hoc via ``os.environ`` across the engine, dispatch, serve plane and
+bench harness, each call site with its own parser and its own idea of what
+``"false"`` means (``!= "0"`` at one site, ``in ("1", "true", ...)`` at
+another). This module declares every knob ONCE — name, type, default,
+applicability, RUNBOOK anchor — and owns the only sanctioned readers
+(``env_str`` / ``env_bool`` / ``env_int`` / ``env_float``). The knob lint
+(``skyline_tpu.analysis.knob_lint``) walks the tree and fails CI on any
+``os.environ`` read outside this module, any accessor read of an
+undeclared knob, and any declared knob nothing reads (dead).
+
+Parsing contract (the PR-6 unification):
+
+- bool: ``"0" / "false" / "no" / "off"`` (any case) are False,
+  ``"1" / "true" / "yes" / "on"`` are True, unset/empty means the
+  call-site default, anything else warns once and means the default.
+  Every boolean knob in the tree goes through this one parser, so
+  ``SKYLINE_MERGE_PRUNE=false`` can no longer silently mean *enabled*
+  while ``SKYLINE_EMIT_PER_SLIDE=false`` means disabled.
+- int / float: unset/empty means the default; an unparseable value warns
+  once and means the default (a typo'd knob must not crash a worker that
+  has been ingesting for an hour).
+
+This module must stay stdlib-only and import-light: ``skyline_tpu/
+__init__.py`` and the dispatch hot path import it.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from dataclasses import dataclass, field
+
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+_FALSY = frozenset(("0", "false", "no", "off"))
+
+# sentinel: "use the knob's declared default" is deliberately NOT the
+# accessor default — call sites state their default explicitly (config.py's
+# flag defaults live on JobConfig) and tests assert the two never drift
+_UNSET = object()
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared runtime knob.
+
+    ``default`` is the documented effective value when the variable is
+    unset (None = unset-sensitive: the call site branches on presence,
+    e.g. SKYLINE_MIXED_PRECISION's backend-dependent auto). ``job_field``
+    names the JobConfig dataclass field a flag-backed knob defaults from —
+    tests assert registry default == JobConfig field default so the table
+    cannot drift. ``external`` marks variables owned by another system
+    (JAX, XLA): read through the accessor for lint coverage, but exempt
+    from the dead-knob and naming checks.
+    """
+
+    name: str
+    type: str  # bool | int | float | str | enum
+    default: object
+    description: str
+    applies_to: str
+    runbook: str = ""
+    choices: tuple = ()
+    job_field: str = ""
+    external: bool = False
+
+    def __post_init__(self):
+        if self.type not in ("bool", "int", "float", "str", "enum"):
+            raise ValueError(f"{self.name}: bad type {self.type!r}")
+        if self.type == "enum" and not self.choices:
+            raise ValueError(f"{self.name}: enum knob needs choices")
+
+
+def _k(name, type, default, description, applies_to, **kw) -> Knob:
+    return Knob(name, type, default, description, applies_to, **kw)
+
+
+KNOBS: tuple[Knob, ...] = (
+    # -- dispatch / engine perf gates (ops/dispatch.py) --------------------
+    _k("SKYLINE_RANK_CASCADE", "bool", False,
+       "dense-rank dominance cascade for the self-skyline passes "
+       "(default off until the hardware A/B lands)", "engine/tpu", runbook="§2"),
+    _k("SKYLINE_MERGE_CACHE", "bool", True,
+       "epoch-keyed global-merge result cache (repeated triggers launch "
+       "zero kernels)", "engine", runbook="§2e"),
+    _k("SKYLINE_DELTA_CUTOFF", "float", 0.75,
+       "max dirty-partition fraction for the delta-merge path; above it "
+       "the full union merge runs", "engine", runbook="§2e"),
+    _k("SKYLINE_STAGE_DEPTH", "int", 1,
+       "flush rounds staged ahead of the in-flight merge kernel "
+       "(0 = no staging, 1 = double buffering)", "engine", runbook="§2e"),
+    _k("SKYLINE_MERGE_TREE", "bool", True,
+       "pruned tournament-tree global merge for d > 2 (0 = flat union "
+       "merge, the A/B baseline)", "engine", runbook="§2f"),
+    _k("SKYLINE_MERGE_PRUNE", "bool", True,
+       "witness-dominance partition prefilter ahead of the tree merge",
+       "engine", runbook="§2f"),
+    _k("SKYLINE_FLUSH_PREFILTER", "bool", True,
+       "quantized-grid host prefilter ahead of the flush merge kernels",
+       "engine", runbook="§2g"),
+    _k("SKYLINE_MIXED_PRECISION", "bool", None,
+       "bf16 margin pass inside the flush dominance kernels; unset = auto "
+       "(on for TPU, off elsewhere — XLA CPU emulates bf16)", "engine",
+       runbook="§2g"),
+    _k("SKYLINE_QUERY_OVERLAP", "bool", True,
+       "overlapped query sync: launch the global merge at trigger time, "
+       "harvest at emission", "engine", runbook="§2f"),
+    _k("SKYLINE_PALLAS_INTERPRET", "bool", False,
+       "run the Pallas kernels in interpret mode on CPU (lowering "
+       "validation without TPU hardware)", "kernels/test"),
+    # -- utils -------------------------------------------------------------
+    _k("SKYLINE_COMPILE_CACHE", "str", None,
+       "persistent XLA compilation cache directory (default: repo-local "
+       ".jax_cache in a source checkout)", "utils"),
+    _k("SKYLINE_PROBE_CACHE_TTL_S", "float", 3600.0,
+       "TTL of the cross-process backend-probe verdict file under "
+       "artifacts/ (0 disables)", "utils/probe"),
+    _k("SKYLINE_PROBE_TIMEOUT_S", "float", 150.0,
+       "backend-probe subprocess timeout", "utils/probe"),
+    _k("BENCH_PROBE_TIMEOUT", "float", 150.0,
+       "legacy alias of SKYLINE_PROBE_TIMEOUT_S (lower precedence)",
+       "utils/probe"),
+    # -- multihost ---------------------------------------------------------
+    _k("SKYLINE_COORDINATOR", "str", None,
+       "jax.distributed coordinator address for multi-host runs",
+       "parallel/multihost"),
+    _k("SKYLINE_NUM_PROCESSES", "int", None,
+       "jax.distributed process count (None = auto-detect)",
+       "parallel/multihost"),
+    _k("SKYLINE_PROCESS_ID", "int", None,
+       "jax.distributed process id (None = auto-detect)",
+       "parallel/multihost"),
+    # -- driver entry (__graft_entry__.py) ---------------------------------
+    _k("SKYLINE_DRYRUN_FORCE_CPU", "bool", False,
+       "skip the hardware probe in dryrun_multichip and emulate on CPU",
+       "driver"),
+    _k("SKYLINE_DRYRUN_PROBE_TIMEOUT", "float", 60.0,
+       "backend-probe timeout inside dryrun_multichip", "driver"),
+    # -- job flags (utils/config.py; SKYLINE_<FLAG> overrides the default,
+    #    the CLI flag overrides both; defaults live on JobConfig) ----------
+    _k("SKYLINE_PARALLELISM", "int", 4, "worker parallelism", "job flag",
+       job_field="parallelism"),
+    _k("SKYLINE_ALGO", "str", "mr-angle", "partitioner algorithm",
+       "job flag", job_field="algo"),
+    _k("SKYLINE_INPUT_TOPIC", "str", "input-tuples", "input topic",
+       "job flag", job_field="input_topic"),
+    _k("SKYLINE_QUERY_TOPIC", "str", "queries", "query topic", "job flag",
+       job_field="query_topic"),
+    _k("SKYLINE_OUTPUT_TOPIC", "str", "output-skyline", "output topic",
+       "job flag", job_field="output_topic"),
+    _k("SKYLINE_DOMAIN", "float", 1000.0, "domain max per dimension",
+       "job flag", job_field="domain"),
+    _k("SKYLINE_DIMS", "int", 2, "tuple dimensionality", "job flag",
+       job_field="dims"),
+    _k("SKYLINE_BOOTSTRAP", "str", "localhost:9092",
+       "Kafka bootstrap address", "job flag", job_field="bootstrap"),
+    _k("SKYLINE_BUFFER_SIZE", "int", 4096, "per-partition buffer size",
+       "job flag", job_field="buffer_size"),
+    _k("SKYLINE_EMIT_SKYLINE_POINTS", "bool", False,
+       "include skyline points in result JSON", "job flag",
+       job_field="emit_skyline_points"),
+    _k("SKYLINE_QUERY_TIMEOUT_MS", "float", 0.0,
+       "finalize overdue queries as partial results (0 = wait forever)",
+       "job flag", job_field="query_timeout_ms"),
+    _k("SKYLINE_GRID_PREFILTER", "bool", False,
+       "domain-midpoint dominance prefilter (the reference's disabled "
+       "GridDominanceFilter, barrier-safe)", "job flag",
+       job_field="grid_prefilter"),
+    _k("SKYLINE_INITIAL_CAPACITY", "int", 0,
+       "pre-size per-partition skyline buffers", "job flag",
+       job_field="initial_capacity"),
+    _k("SKYLINE_FLUSH_POLICY", "enum", "incremental", "flush policy",
+       "job flag", choices=("incremental", "lazy", "overlap"),
+       job_field="flush_policy"),
+    _k("SKYLINE_OVERLAP_ROWS", "int", 262144,
+       "rows between automatic flushes under flush-policy overlap",
+       "job flag", job_field="overlap_rows"),
+    _k("SKYLINE_INGEST", "enum", "auto",
+       "where routing/sort/block assembly runs", "job flag",
+       choices=("auto", "host", "device"), job_field="ingest"),
+    _k("SKYLINE_MESH", "int", 0,
+       "shard partitions over this many devices (0 = single device)",
+       "job flag", job_field="mesh"),
+    _k("SKYLINE_STATS_PORT", "int", 0,
+       "serve live /stats JSON on this port (0 = off)", "job flag",
+       runbook="§2b", job_field="stats_port"),
+    _k("SKYLINE_WINDOW", "int", 0,
+       "sliding-window size in tuples (0 = unbounded)", "job flag",
+       runbook="§2c", job_field="window_size"),
+    _k("SKYLINE_SLIDE", "int", 0, "slide in tuples (with SKYLINE_WINDOW)",
+       "job flag", runbook="§2c", job_field="slide"),
+    _k("SKYLINE_EMIT_PER_SLIDE", "bool", False,
+       "emit one result JSON per completed slide", "job flag",
+       runbook="§2c", job_field="emit_per_slide"),
+    _k("SKYLINE_MAX_DRAIN_POLLS", "int", 256,
+       "cap on trigger-pending data re-polls per worker step", "job flag",
+       job_field="max_drain_polls"),
+    _k("SKYLINE_SERVE", "int", -1,
+       "query-serving plane port (-1 = off, 0 = pick a free port)",
+       "job flag", runbook="§2d", job_field="serve_port"),
+    _k("SKYLINE_SERVE_READ_RATE", "float", 0.0,
+       "snapshot-read token rate per second (0 = unlimited)", "job flag",
+       runbook="§2d", job_field="serve_read_rate"),
+    _k("SKYLINE_SERVE_READ_BURST", "int", 256,
+       "snapshot-read token bucket capacity", "job flag", runbook="§2d",
+       job_field="serve_read_burst"),
+    _k("SKYLINE_SERVE_MAX_QUERIES", "int", 2,
+       "concurrent forced merges (POST /query)", "job flag",
+       runbook="§2d", job_field="serve_max_queries"),
+    _k("SKYLINE_SERVE_QUERY_QUEUE", "int", 8,
+       "queued forced merges beyond the concurrent cap", "job flag",
+       runbook="§2d", job_field="serve_query_queue"),
+    _k("SKYLINE_SERVE_QUERY_DEADLINE_MS", "float", 10_000.0,
+       "deadline for an admitted forced merge", "job flag", runbook="§2d",
+       job_field="serve_query_deadline_ms"),
+    _k("SKYLINE_SERVE_DELTA_RING", "int", 128,
+       "snapshot transitions kept for /deltas catch-up", "job flag",
+       runbook="§2d", job_field="serve_delta_ring"),
+    _k("SKYLINE_SERVE_HISTORY", "int", 64,
+       "snapshot versions retained in the store", "job flag",
+       runbook="§2d", job_field="serve_history"),
+    _k("SKYLINE_SERVE_READ_CACHE", "int", 64,
+       "serialized-response LRU entries (0 disables)", "job flag",
+       runbook="§2e", job_field="serve_read_cache"),
+    _k("SKYLINE_TRACE_OUT", "str", "",
+       "write the span ring as Chrome trace-event JSON on shutdown",
+       "job flag", runbook="§2b", job_field="trace_out"),
+    _k("SKYLINE_TRACE_RING", "int", 4096, "span ring capacity",
+       "job flag", runbook="§2b", job_field="trace_ring"),
+    _k("SKYLINE_JAX_PROFILE_DIR", "str", "",
+       "wrap each forced-query injection in jax.profiler.trace",
+       "job flag", runbook="§2b", job_field="jax_profile_dir"),
+    # -- bench harness (bench.py) ------------------------------------------
+    _k("BENCH_N", "int", None,
+       "window rows (default 1M on TPU, BENCH_CPU_N on the fallback)",
+       "bench"),
+    _k("BENCH_CPU_N", "int", 131072, "window rows for the CPU fallback",
+       "bench"),
+    _k("BENCH_D", "int", 8, "tuple dimensionality", "bench"),
+    _k("BENCH_WINDOWS", "int", None,
+       "measured windows (default 5 on TPU, 1 on the CPU fallback)",
+       "bench"),
+    _k("BENCH_PARALLELISM", "int", 4, "engine parallelism", "bench"),
+    _k("BENCH_ALGO", "str", "mr-angle", "partitioner for the bench run",
+       "bench"),
+    _k("BENCH_BUFFER", "int", 8192, "per-partition buffer size", "bench"),
+    _k("BENCH_INITIAL_CAP", "int", 65536,
+       "pre-sized per-partition skyline capacity", "bench"),
+    _k("BENCH_FLUSH_POLICY", "str", "lazy", "flush policy for the bench run",
+       "bench"),
+    _k("BENCH_SERVE", "bool", True, "run the serving-plane bench leg",
+       "bench"),
+    _k("BENCH_SERVE_N", "int", 65536, "serve-leg window rows", "bench"),
+    _k("BENCH_SERVE_READERS", "int", 32, "serve-leg reader threads",
+       "bench"),
+    _k("BENCH_SERVE_READS", "int", 25, "serve-leg reads per reader",
+       "bench"),
+    _k("BENCH_SERVE_POINTS", "bool", False,
+       "serve-leg full-payload reads instead of metadata-only", "bench"),
+    _k("BENCH_COMPILE_CACHE", "str", None,
+       "persistent compile-cache dir override for bench children", "bench"),
+    _k("BENCH_PROBE_ATTEMPTS", "int", 2, "backend-probe attempts", "bench"),
+    _k("BENCH_PROBE_BACKOFF", "float", 20.0,
+       "seconds between probe attempts", "bench"),
+    _k("BENCH_CHILD_TIMEOUT", "float", 3000.0,
+       "bounded child-run timeout in seconds", "bench"),
+    _k("BENCH_TPU_ATTEMPTS", "int", 2, "TPU child-run attempts", "bench"),
+    _k("BENCH_FORCE_CPU", "bool", False, "skip the TPU leg entirely",
+       "bench"),
+    # -- external (owned by JAX/XLA; declared for lint coverage) -----------
+    _k("JAX_PLATFORMS", "str", None, "JAX backend selection (external)",
+       "external", external=True),
+    _k("XLA_FLAGS", "str", None, "XLA runtime flags (external)",
+       "external", external=True),
+)
+
+_BY_NAME: dict[str, Knob] = {k.name: k for k in KNOBS}
+if len(_BY_NAME) != len(KNOBS):  # duplicate declaration is a bug, not data
+    raise RuntimeError("duplicate knob declaration in KNOBS")
+
+_warned: set[str] = set()
+
+
+def knob(name: str) -> Knob:
+    """The declaration behind ``name`` (raises LookupError if undeclared —
+    the runtime mirror of the knob lint's undeclared-knob rule)."""
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise LookupError(
+            f"env knob {name!r} is not declared in "
+            "skyline_tpu.analysis.registry.KNOBS"
+        ) from None
+
+
+def knob_names() -> tuple[str, ...]:
+    return tuple(_BY_NAME)
+
+
+def _warn_once(name: str, raw: str, why: str) -> None:
+    if name not in _warned:
+        _warned.add(name)
+        warnings.warn(
+            f"ignoring {name}={raw!r}: {why}; using the default",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+def _raw(name: str) -> str | None:
+    knob(name)  # undeclared reads fail fast, even at runtime
+    return os.environ.get(name)
+
+
+def env_str(name: str, default=None):
+    """String knob: unset or empty means ``default``."""
+    v = _raw(name)
+    if v is None or v == "":
+        return default
+    return v
+
+
+def parse_bool(raw: str | None, default=False):
+    """THE boolean parse. ``"0"/"false"/"no"/"off"`` (any case) are False;
+    ``"1"/"true"/"yes"/"on"`` are True; unset/empty/unrecognized mean
+    ``default`` (which may be None for unset-sensitive tri-state knobs)."""
+    if raw is None:
+        return default
+    s = raw.strip().lower()
+    if s == "" or (s not in _FALSY and s not in _TRUTHY):
+        return default
+    return s in _TRUTHY
+
+
+def env_bool(name: str, default=False):
+    v = _raw(name)
+    if v is not None and v.strip() != "":
+        s = v.strip().lower()
+        if s not in _FALSY and s not in _TRUTHY:
+            _warn_once(name, v, "not a recognized boolean")
+    return parse_bool(v, default)
+
+
+def env_int(name: str, default=0):
+    v = _raw(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        _warn_once(name, v, "not an integer")
+        return default
+
+
+def env_float(name: str, default=0.0):
+    v = _raw(name)
+    if v is None or v.strip() == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        _warn_once(name, v, "not a number")
+        return default
+
+
+# accessor names the knob lint recognizes as sanctioned read sites
+ACCESSORS = ("env_str", "env_bool", "env_int", "env_float")
+
+
+def _fmt_default(k: Knob) -> str:
+    if k.default is None:
+        return "unset"
+    if k.type == "bool":
+        return "on" if k.default else "off"
+    return repr(k.default) if isinstance(k.default, str) else str(k.default)
+
+
+def knob_doc_markdown() -> str:
+    """The autogenerated knob table (``--knob-doc`` writes it to
+    docs/KNOBS.md; ``--check-doc`` fails CI on drift)."""
+    lines = [
+        "# Runtime knobs",
+        "",
+        "Autogenerated by `python -m skyline_tpu.analysis --knob-doc` from",
+        "`skyline_tpu/analysis/registry.py` — edit the registry, not this",
+        "file (`--check-doc` fails CI on drift).",
+        "",
+        "Boolean knobs share one parser: `0/false/no/off` disable,",
+        "`1/true/yes/on` enable, unset/empty/unrecognized mean the default.",
+        "",
+        "| Knob | Type | Default | Applies to | RUNBOOK | Description |",
+        "|---|---|---|---|---|---|",
+    ]
+    for k in KNOBS:
+        typ = k.type if not k.choices else "enum(" + "\\|".join(k.choices) + ")"
+        lines.append(
+            f"| `{k.name}` | {typ} | {_fmt_default(k)} | {k.applies_to} "
+            f"| {k.runbook or '—'} | {k.description} |"
+        )
+    lines.append("")
+    lines.append(f"{len(KNOBS)} knobs declared.")
+    lines.append("")
+    return "\n".join(lines)

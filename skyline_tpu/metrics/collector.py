@@ -30,7 +30,7 @@ class Counters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counts: dict[str, int] = {}
+        self._counts: dict[str, int] = {}  # guarded-by: self._lock
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:

@@ -29,9 +29,9 @@ def rank_cascade() -> bool:
     artifacts/rank_cascade_ab.json) and flip the default only on a >=1.15x
     measured win. Read lazily at trace time; already-compiled executables
     are unaffected by later changes."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_RANK_CASCADE", "0") != "0"
+    return env_bool("SKYLINE_RANK_CASCADE", False)
 
 
 def merge_cache_enabled() -> bool:
@@ -44,9 +44,9 @@ def merge_cache_enabled() -> bool:
     the from-scratch full merge on every trigger (the A/B baseline the
     equivalence tests and benchmarks/merge_cache.py compare against). Read
     lazily per query, so tests can flip it per-case."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_MERGE_CACHE", "1") != "0"
+    return env_bool("SKYLINE_MERGE_CACHE", True)
 
 
 def delta_dirty_cutoff() -> float:
@@ -56,12 +56,9 @@ def delta_dirty_cutoff() -> float:
     union anyway and the delta assembly's extra executable shapes (one per
     dirty pattern) buy nothing. Default 0.75; ``0`` disables delta merges
     while keeping the exact-hit cache."""
-    import os
+    from skyline_tpu.analysis.registry import env_float
 
-    try:
-        return float(os.environ.get("SKYLINE_DELTA_CUTOFF", "0.75"))
-    except ValueError:
-        return 0.75
+    return env_float("SKYLINE_DELTA_CUTOFF", 0.75)
 
 
 def flush_stage_depth() -> int:
@@ -72,12 +69,9 @@ def flush_stage_depth() -> int:
     micro-batches resident in host+device memory; 0 disables staging
     (assemble-then-dispatch strictly in order, the pre-pipelining
     behavior)."""
-    import os
+    from skyline_tpu.analysis.registry import env_int
 
-    try:
-        return max(0, int(os.environ.get("SKYLINE_STAGE_DEPTH", "1")))
-    except ValueError:
-        return 1
+    return max(0, env_int("SKYLINE_STAGE_DEPTH", 1))
 
 
 def merge_tree_enabled() -> bool:
@@ -91,9 +85,9 @@ def merge_tree_enabled() -> bool:
     benchmarks/merge_cache.py compare against. Results are byte-identical
     either way (merge law + stable compaction order). Read lazily per
     query."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_MERGE_TREE", "1") != "0"
+    return env_bool("SKYLINE_MERGE_TREE", True)
 
 
 def merge_prune_enabled() -> bool:
@@ -106,9 +100,9 @@ def merge_prune_enabled() -> bool:
     ON; set ``0`` to feed every non-empty partition into the tree (the
     digest check in scripts/obs_smoke.sh compares both settings). Read
     lazily per query."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_MERGE_PRUNE", "1") != "0"
+    return env_bool("SKYLINE_MERGE_PRUNE", True)
 
 
 def flush_prefilter_enabled() -> bool:
@@ -124,9 +118,9 @@ def flush_prefilter_enabled() -> bool:
     transitive dominators). Default ON; set ``0`` for the exact-only
     baseline (byte-identical output, asserted in tests/test_flush_cascade.py
     and scripts/obs_smoke.sh). Read lazily per flush."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_FLUSH_PREFILTER", "1") != "0"
+    return env_bool("SKYLINE_FLUSH_PREFILTER", True)
 
 
 def mixed_precision_enabled() -> bool:
@@ -143,12 +137,11 @@ def mixed_precision_enabled() -> bool:
     wins, on any backend. Threaded as a static jit argument from the flush
     orchestration, so flipping it per-call really switches executables
     (unlike trace-time env reads)."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    v = os.environ.get("SKYLINE_MIXED_PRECISION")
-    if v is not None and v != "":
-        return v != "0"
-    return on_tpu()
+    # env_bool falls back to the default for unset/empty/unrecognized, so
+    # the backend-derived default applies exactly when no explicit value set
+    return env_bool("SKYLINE_MIXED_PRECISION", on_tpu())
 
 
 def query_overlap_enabled() -> bool:
@@ -160,9 +153,9 @@ def query_overlap_enabled() -> bool:
     single-host engines; set ``0`` to restore the blocking
     launch-then-sync trigger path. Emitted results are identical either
     way. Read lazily per trigger."""
-    import os
+    from skyline_tpu.analysis.registry import env_bool
 
-    return os.environ.get("SKYLINE_QUERY_OVERLAP", "1") != "0"
+    return env_bool("SKYLINE_QUERY_OVERLAP", True)
 
 
 def skyline_mask_auto(x, valid=None):

@@ -26,8 +26,6 @@ that donation would invalidate.
 from __future__ import annotations
 
 import functools
-import os
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -56,7 +54,9 @@ def pallas_interpret() -> bool:
     shard_map-of-pallas_call lowering without TPU hardware. Evaluated when a
     kernel first traces; already-compiled executables are unaffected by
     later env changes."""
-    return os.environ.get("SKYLINE_PALLAS_INTERPRET", "") == "1"
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_PALLAS_INTERPRET", False)
 
 
 def sfs_round_core(sky, count, block, bvalid, active, use_pallas, interp, mp=False):

@@ -25,8 +25,6 @@ stage-2 all_gather rides DCN.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -49,11 +47,13 @@ def init_multihost(
     single-process). Arguments default to the ``SKYLINE_COORDINATOR``,
     ``SKYLINE_NUM_PROCESSES``, ``SKYLINE_PROCESS_ID`` env vars; on cloud TPU
     pods all three may be None (auto-detected by JAX)."""
-    coordinator_address = coordinator_address or os.environ.get("SKYLINE_COORDINATOR")
-    if num_processes is None and "SKYLINE_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["SKYLINE_NUM_PROCESSES"])
-    if process_id is None and "SKYLINE_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["SKYLINE_PROCESS_ID"])
+    from skyline_tpu.analysis.registry import env_int, env_str
+
+    coordinator_address = coordinator_address or env_str("SKYLINE_COORDINATOR")
+    if num_processes is None:
+        num_processes = env_int("SKYLINE_NUM_PROCESSES", None)
+    if process_id is None:
+        process_id = env_int("SKYLINE_PROCESS_ID", None)
     if num_processes is not None and num_processes <= 1:
         return
     if coordinator_address is None and num_processes is None and process_id is None:

@@ -80,9 +80,11 @@ class DeltaRing:
     """
 
     def __init__(self, store=None, capacity: int = 128):
-        self._ring: deque[Delta] = deque(maxlen=max(1, capacity))
+        self._ring: deque[Delta] = deque(  # guarded-by: self._lock
+            maxlen=max(1, capacity)
+        )
         self._lock = threading.Lock()
-        self.head_version = 0
+        self.head_version = 0  # guarded-by: self._lock
         if store is not None:
             store.on_publish(self.on_publish)
 

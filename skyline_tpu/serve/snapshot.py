@@ -96,19 +96,24 @@ class SnapshotStore:
     """
 
     def __init__(self, history: int = 64):
-        self._latest: Snapshot | None = None
-        self._history: deque[Snapshot] = deque(maxlen=max(1, history))
-        self._version = 0
+        self._latest: Snapshot | None = None  # guarded-by: self._write_lock
+        self._history: deque[Snapshot] = deque(  # guarded-by: self._write_lock
+            maxlen=max(1, history)
+        )
+        self._version = 0  # guarded-by: self._write_lock
+        # _advances/_stream_watermark are deliberately NOT lock-guarded:
+        # note_ingest runs on the hot ingest path and tolerates torn reads
+        # (they feed monotonic lag gauges, not correctness)
         self._advances = 0  # ingest advances since the last publish
         self._stream_watermark = -1
         self._write_lock = threading.Lock()
         self._subscribers: list = []  # publish callbacks (delta ring, tests)
-        self.published = 0
+        self.published = 0  # guarded-by: self._write_lock
         # opaque identity of the engine state behind _latest (the partition
         # epoch key): a publish with the same key is a byte-identical repeat
         # (merge-cache hit upstream) and dedupes instead of minting a version
-        self._source_key = None
-        self.deduped = 0
+        self._source_key = None  # guarded-by: self._write_lock
+        self.deduped = 0  # guarded-by: self._write_lock
 
     # -- writer side (engine thread) --------------------------------------
 

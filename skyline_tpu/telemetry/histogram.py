@@ -48,12 +48,12 @@ class Histogram:
         if any(b <= a for a, b in zip(self._edges, self._edges[1:])):
             raise ValueError("histogram edges must be strictly increasing")
         # counts[i] covers (edges[i-1], edges[i]]; counts[-1] is overflow
-        self._counts = [0] * (len(self._edges) + 1)
-        self._count = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
-        self._samples: list[float] = []
+        self._counts = [0] * (len(self._edges) + 1)  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+        self._sum = 0.0  # guarded-by: self._lock
+        self._min = float("inf")  # guarded-by: self._lock
+        self._max = float("-inf")  # guarded-by: self._lock
+        self._samples: list[float] = []  # guarded-by: self._lock
         self._sample_cap = max(0, int(sample_cap))
         self._lock = threading.Lock()
 

@@ -45,14 +45,16 @@ class SpanRecorder:
 
     def __init__(self, capacity: int = 4096):
         self.capacity = max(1, int(capacity))
-        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._ring: deque[dict] = deque(  # guarded-by: self._lock
+            maxlen=self.capacity
+        )
         self._lock = threading.Lock()
         self._pid = os.getpid()
         # anchor: chrome ts values are microseconds relative to recorder
         # creation; the wall anchor lets a reader place the trace in time
         self._anchor_ns = time.perf_counter_ns()
         self.anchor_epoch_ms = time.time() * 1000.0
-        self.recorded = 0  # total ever recorded (ring holds the tail)
+        self.recorded = 0  # total ever recorded  # guarded-by: self._lock
 
     def record(
         self,

@@ -50,15 +50,9 @@ def _cache_path() -> str:
 
 
 def probe_cache_ttl_s(default: float = 3600.0) -> float:
-    import os
+    from skyline_tpu.analysis.registry import env_float
 
-    v = os.environ.get("SKYLINE_PROBE_CACHE_TTL_S")
-    if v:
-        try:
-            return float(v)
-        except ValueError:
-            pass
-    return default
+    return env_float("SKYLINE_PROBE_CACHE_TTL_S", default)
 
 
 def _load_file_verdict() -> dict | None:
@@ -99,16 +93,12 @@ def _store_file_verdict(diag: dict) -> None:
 def probe_timeout_s(default: float = 150.0) -> float:
     """Resolve the probe timeout: ``SKYLINE_PROBE_TIMEOUT_S`` wins, then the
     legacy ``BENCH_PROBE_TIMEOUT``, then ``default``."""
-    import os
+    from skyline_tpu.analysis.registry import env_float
 
-    for var in ("SKYLINE_PROBE_TIMEOUT_S", "BENCH_PROBE_TIMEOUT"):
-        v = os.environ.get(var)
-        if v:
-            try:
-                return float(v)
-            except ValueError:
-                pass
-    return default
+    v = env_float("SKYLINE_PROBE_TIMEOUT_S", None)
+    if v is None:
+        v = env_float("BENCH_PROBE_TIMEOUT", None)
+    return default if v is None else v
 
 
 def probe_backend(

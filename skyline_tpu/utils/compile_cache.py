@@ -18,7 +18,9 @@ def default_cache_dir() -> str:
     """``SKYLINE_COMPILE_CACHE`` if set; else ``.jax_cache`` next to the
     package (the repo root in a source checkout — the same directory
     bench.py and the benchmark runners use); else ``~/.cache``-based."""
-    env = os.environ.get("SKYLINE_COMPILE_CACHE")
+    from skyline_tpu.analysis.registry import env_str
+
+    env = env_str("SKYLINE_COMPILE_CACHE")
     if env:
         return env
     pkg_parent = os.path.dirname(

@@ -13,8 +13,8 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import os
 
+from skyline_tpu.analysis.registry import env_bool, env_float, env_int, env_str
 from skyline_tpu.stream.engine import EngineConfig
 
 _ALGOS = ("mr-dim", "mr-grid", "mr-angle")
@@ -229,122 +229,129 @@ def parse_job_args(argv=None) -> JobConfig:
     defaults = JobConfig()
     ap = argparse.ArgumentParser(description="tpu-skyline job flags")
     ap.add_argument("--parallelism", type=int,
-                    default=_env_int("PARALLELISM", defaults.parallelism))
-    ap.add_argument("--algo", default=os.environ.get("SKYLINE_ALGO", defaults.algo))
+                    default=env_int("SKYLINE_PARALLELISM", defaults.parallelism))
+    ap.add_argument("--algo", default=env_str("SKYLINE_ALGO", defaults.algo))
     ap.add_argument("--input-topic",
-                    default=os.environ.get("SKYLINE_INPUT_TOPIC", defaults.input_topic))
+                    default=env_str("SKYLINE_INPUT_TOPIC", defaults.input_topic))
     ap.add_argument("--query-topic",
-                    default=os.environ.get("SKYLINE_QUERY_TOPIC", defaults.query_topic))
+                    default=env_str("SKYLINE_QUERY_TOPIC", defaults.query_topic))
     ap.add_argument("--output-topic",
-                    default=os.environ.get("SKYLINE_OUTPUT_TOPIC", defaults.output_topic))
-    ap.add_argument("--domain", type=float, default=_env_float("DOMAIN", defaults.domain))
-    ap.add_argument("--dims", type=int, default=_env_int("DIMS", defaults.dims))
+                    default=env_str("SKYLINE_OUTPUT_TOPIC", defaults.output_topic))
+    ap.add_argument("--domain", type=float,
+                    default=env_float("SKYLINE_DOMAIN", defaults.domain))
+    ap.add_argument("--dims", type=int,
+                    default=env_int("SKYLINE_DIMS", defaults.dims))
     ap.add_argument("--bootstrap",
-                    default=os.environ.get("SKYLINE_BOOTSTRAP", defaults.bootstrap))
+                    default=env_str("SKYLINE_BOOTSTRAP", defaults.bootstrap))
     ap.add_argument("--buffer-size", type=int,
-                    default=_env_int("BUFFER_SIZE", defaults.buffer_size))
+                    default=env_int("SKYLINE_BUFFER_SIZE", defaults.buffer_size))
     ap.add_argument("--emit-skyline-points", action="store_true",
-                    default=_env_bool("EMIT_SKYLINE_POINTS"))
+                    default=env_bool("SKYLINE_EMIT_SKYLINE_POINTS"))
     ap.add_argument("--query-timeout-ms", type=float,
-                    default=_env_float("QUERY_TIMEOUT_MS", defaults.query_timeout_ms),
+                    default=env_float("SKYLINE_QUERY_TIMEOUT_MS",
+                                      defaults.query_timeout_ms),
                     help="finalize overdue queries as partial results after "
                          "this long (0 = wait forever, reference behavior)")
     ap.add_argument("--grid-prefilter", action="store_true",
-                    default=_env_bool("GRID_PREFILTER"),
+                    default=env_bool("SKYLINE_GRID_PREFILTER"),
                     help="drop tuples dominated by the domain midpoint "
                          "(the reference's disabled GridDominanceFilter, "
                          "implemented barrier-safely)")
     ap.add_argument("--initial-capacity", type=int,
-                    default=_env_int("INITIAL_CAPACITY", defaults.initial_capacity),
+                    default=env_int("SKYLINE_INITIAL_CAPACITY",
+                                    defaults.initial_capacity),
                     help="pre-size per-partition skyline buffers")
     ap.add_argument("--flush-policy",
                     choices=("incremental", "lazy", "overlap"),
-                    default=os.environ.get("SKYLINE_FLUSH_POLICY",
-                                           defaults.flush_policy))
+                    default=env_str("SKYLINE_FLUSH_POLICY",
+                                    defaults.flush_policy))
     ap.add_argument("--overlap-rows", type=int,
-                    default=_env_int("OVERLAP_ROWS", defaults.overlap_rows),
+                    default=env_int("SKYLINE_OVERLAP_ROWS",
+                                    defaults.overlap_rows),
                     help="rows between automatic flushes under "
                          "--flush-policy overlap (device work then overlaps "
                          "transport/parse of the next chunk)")
     ap.add_argument("--ingest", choices=("auto", "host", "device"),
-                    default=os.environ.get("SKYLINE_INGEST", defaults.ingest),
+                    default=env_str("SKYLINE_INGEST", defaults.ingest),
                     help="where routing/sort/block assembly runs: auto "
                          "picks device on a single accelerator under "
                          "lazy/overlap")
-    ap.add_argument("--mesh", type=int, default=_env_int("MESH", defaults.mesh),
+    ap.add_argument("--mesh", type=int,
+                    default=env_int("SKYLINE_MESH", defaults.mesh),
                     help="shard the partition state over this many devices "
                          "(0 = single device)")
     ap.add_argument("--stats-port", type=int,
-                    default=_env_int("STATS_PORT", defaults.stats_port),
+                    default=env_int("SKYLINE_STATS_PORT", defaults.stats_port),
                     help="serve live /stats JSON on this port (0 = off)")
     ap.add_argument("--window", type=int, dest="window_size",
-                    default=_env_int("WINDOW", defaults.window_size),
+                    default=env_int("SKYLINE_WINDOW", defaults.window_size),
                     help="sliding-window size in tuples (0 = unbounded, "
                          "the reference's semantics)")
     ap.add_argument("--slide", type=int,
-                    default=_env_int("SLIDE", defaults.slide),
+                    default=env_int("SKYLINE_SLIDE", defaults.slide),
                     help="slide in tuples (with --window)")
     ap.add_argument("--emit-per-slide", action="store_true",
-                    default=_env_bool("EMIT_PER_SLIDE"),
+                    default=env_bool("SKYLINE_EMIT_PER_SLIDE"),
                     help="emit one result JSON per completed slide in "
                          "addition to trigger-driven results")
     ap.add_argument("--max-drain-polls", type=int,
-                    default=_env_int("MAX_DRAIN_POLLS",
-                                     defaults.max_drain_polls),
+                    default=env_int("SKYLINE_MAX_DRAIN_POLLS",
+                                    defaults.max_drain_polls),
                     help="cap on trigger-pending data re-polls per step; "
                          "raise for finite streams larger than "
                          "max_drain_polls * 65536 rows")
     ap.add_argument("--serve", type=int, dest="serve_port",
-                    default=_env_int("SERVE", defaults.serve_port),
+                    default=env_int("SKYLINE_SERVE", defaults.serve_port),
                     help="start the query-serving plane (snapshot reads, "
                          "forced merges, delta catch-up) on this port "
                          "(-1 = off, 0 = pick a free port)")
     ap.add_argument("--serve-read-rate", type=float,
-                    default=_env_float("SERVE_READ_RATE",
-                                       defaults.serve_read_rate),
+                    default=env_float("SKYLINE_SERVE_READ_RATE",
+                                      defaults.serve_read_rate),
                     help="snapshot-read token rate per second "
                          "(0 = unlimited); exhaustion sheds with 429")
     ap.add_argument("--serve-read-burst", type=int,
-                    default=_env_int("SERVE_READ_BURST",
-                                     defaults.serve_read_burst),
+                    default=env_int("SKYLINE_SERVE_READ_BURST",
+                                    defaults.serve_read_burst),
                     help="snapshot-read token bucket capacity")
     ap.add_argument("--serve-max-queries", type=int,
-                    default=_env_int("SERVE_MAX_QUERIES",
-                                     defaults.serve_max_queries),
+                    default=env_int("SKYLINE_SERVE_MAX_QUERIES",
+                                    defaults.serve_max_queries),
                     help="concurrent forced merges (POST /query)")
     ap.add_argument("--serve-query-queue", type=int,
-                    default=_env_int("SERVE_QUERY_QUEUE",
-                                     defaults.serve_query_queue),
+                    default=env_int("SKYLINE_SERVE_QUERY_QUEUE",
+                                    defaults.serve_query_queue),
                     help="queued forced merges beyond the concurrent cap; "
                          "beyond that POST /query sheds with 429")
     ap.add_argument("--serve-query-deadline-ms", type=float,
-                    default=_env_float("SERVE_QUERY_DEADLINE_MS",
-                                       defaults.serve_query_deadline_ms),
+                    default=env_float("SKYLINE_SERVE_QUERY_DEADLINE_MS",
+                                      defaults.serve_query_deadline_ms),
                     help="deadline for an admitted forced merge")
     ap.add_argument("--serve-delta-ring", type=int,
-                    default=_env_int("SERVE_DELTA_RING",
-                                     defaults.serve_delta_ring),
+                    default=env_int("SKYLINE_SERVE_DELTA_RING",
+                                    defaults.serve_delta_ring),
                     help="snapshot transitions kept for /deltas catch-up")
     ap.add_argument("--serve-history", type=int,
-                    default=_env_int("SERVE_HISTORY",
-                                     defaults.serve_history),
+                    default=env_int("SKYLINE_SERVE_HISTORY",
+                                    defaults.serve_history),
                     help="snapshot versions retained in the store")
     ap.add_argument("--serve-read-cache", type=int,
-                    default=_env_int("SERVE_READ_CACHE",
-                                     defaults.serve_read_cache),
+                    default=env_int("SKYLINE_SERVE_READ_CACHE",
+                                    defaults.serve_read_cache),
                     help="serialized-response LRU entries (0 disables)")
     ap.add_argument("--trace-out",
-                    default=os.environ.get("SKYLINE_TRACE_OUT",
-                                           defaults.trace_out),
+                    default=env_str("SKYLINE_TRACE_OUT",
+                                    defaults.trace_out),
                     help="write the per-query span ring as Chrome "
                          "trace-event JSON to this path on shutdown "
                          "(load at https://ui.perfetto.dev)")
     ap.add_argument("--trace-ring", type=int,
-                    default=_env_int("TRACE_RING", defaults.trace_ring),
+                    default=env_int("SKYLINE_TRACE_RING",
+                                    defaults.trace_ring),
                     help="span ring capacity (most recent spans kept)")
     ap.add_argument("--jax-profile-dir",
-                    default=os.environ.get("SKYLINE_JAX_PROFILE_DIR",
-                                           defaults.jax_profile_dir),
+                    default=env_str("SKYLINE_JAX_PROFILE_DIR",
+                                    defaults.jax_profile_dir),
                     help="opt-in: wrap each forced-query injection "
                          "(POST /query) in jax.profiler.trace writing to "
                          "this directory")
@@ -385,20 +392,3 @@ def parse_job_args(argv=None) -> JobConfig:
         trace_ring=a.trace_ring,
         jax_profile_dir=a.jax_profile_dir,
     )
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(f"SKYLINE_{name}")
-    return int(v) if v else default
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(f"SKYLINE_{name}")
-    return float(v) if v else default
-
-
-def _env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(f"SKYLINE_{name}")
-    if v is None or v == "":
-        return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
