@@ -103,6 +103,45 @@ def analysis_stamp() -> dict:
     }
 
 
+def resilience_stamp() -> dict:
+    """Crash-safety provenance for the bench artifact: the fault hook's
+    disabled cost (it sits on the flush/poll hot paths — must stay a
+    global-load + None check), raw WAL append throughput with fsync off,
+    and the effective durability knobs. See benchmarks/resilience.py for
+    the full A/B."""
+    import shutil
+    import tempfile
+
+    from skyline_tpu.resilience.faults import active_plan, fault_point
+    from skyline_tpu.resilience.wal import WalWriter
+
+    assert active_plan() is None  # measure the disabled path
+    calls = 200_000
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        fault_point("kafka.poll")
+    hook_ns = (time.perf_counter() - t0) / calls * 1e9
+    tmp = tempfile.mkdtemp(prefix="skyline-bench-wal-")
+    try:
+        w = WalWriter(tmp, fsync="off")
+        rec = {"type": "commit", "data_off": 123456, "query_off": 7}
+        appends = 2000
+        t0 = time.perf_counter()
+        for _ in range(appends):
+            w.append(rec)
+        append_us = (time.perf_counter() - t0) / appends * 1e6
+        w.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "fault_hook_disabled_ns": round(hook_ns, 1),
+        "wal_append_us_fsync_off": round(append_us, 2),
+        "wal_fsync_policy": env_str("SKYLINE_WAL_FSYNC", "batch"),
+        "checkpoint_interval_s": env_float("SKYLINE_CHECKPOINT_INTERVAL_S", 30.0),
+        "supervisor_max_restarts": env_int("SKYLINE_SUPERVISOR_MAX_RESTARTS", 5),
+    }
+
+
 # --------------------------------------------------------------------------
 # worker: the measured benchmark (runs in a child process)
 # --------------------------------------------------------------------------
@@ -387,6 +426,10 @@ def child_main(backend: str) -> None:
         analysis = analysis_stamp()
     except Exception as e:  # pragma: no cover - diagnostic path
         analysis = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        resilience = resilience_stamp()
+    except Exception as e:  # pragma: no cover - diagnostic path
+        resilience = {"error": f"{type(e).__name__}: {e}"}
     print(
         json.dumps(
             {
@@ -411,6 +454,7 @@ def child_main(backend: str) -> None:
                 "serve": serve,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
+                "resilience": resilience,
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,

@@ -1,0 +1,217 @@
+"""A/B: crash-safety overhead (ISSUE 7) — what durability costs when it is
+off, and what it costs when it is on.
+
+Four legs, all on one process:
+
+- hook:  the ``fault_point`` call disabled (no plan) vs armed with a
+  never-matching plan — this hook sits on ``step()``/``flush_all``'s hot
+  path in EVERY run, crash safety on or off, so the disabled cost is the
+  one that must stay immeasurable.
+- e2e:   identical streams driven through a worker with resilience off vs
+  on (WAL + per-step commit under ``fsync=off``) over a MemoryBus —
+  skyline byte-identity asserted, the wall delta is the WAL tax.
+- wal:   raw append throughput per fsync policy (off / batch / always);
+  ``always`` pays a platter sync per record and exists to make the cost
+  of that choice visible, not to recommend it.
+- ckpt:  checkpoint save / restore_latest wall for a populated engine.
+
+Writes ``artifacts/resilience_ab.json``.
+
+Usage: python benchmarks/resilience.py [--n 20000] [--d 4] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def bench_hook(calls: int = 500_000) -> dict:
+    from skyline_tpu.resilience.faults import (
+        FaultPlan,
+        clear,
+        fault_point,
+        install_plan,
+    )
+
+    def loop() -> float:
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            fault_point("kafka.poll")
+        return (time.perf_counter() - t0) / calls * 1e9
+
+    clear()
+    disabled_ns = loop()
+    install_plan(FaultPlan.parse("crash@kafka.poll:1000000000"))
+    armed_ns = loop()
+    clear()
+    return {
+        "calls": calls,
+        "disabled_ns_per_call": round(disabled_ns, 1),
+        "armed_unmatched_ns_per_call": round(armed_ns, 1),
+    }
+
+
+def _drive(rows, d: int, resilience) -> tuple[float, bytes, int]:
+    """One full stream -> trigger -> result through a worker; returns
+    (wall_s, skyline_bytes, skyline_size)."""
+    from skyline_tpu.bridge import MemoryBus, SkylineWorker
+    from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+    from skyline_tpu.stream import EngineConfig
+
+    bus = MemoryBus()
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, r) for i, r in enumerate(rows)],
+    )
+    out = bus.consumer("output-skyline", from_beginning=True)
+    w = SkylineWorker(
+        bus,
+        EngineConfig(parallelism=4, dims=d, domain_max=10000.0,
+                     buffer_size=4096, emit_skyline_points=True),
+        resilience=resilience,
+    )
+    bus.produce("queries", format_trigger(0, 0))
+    t0 = time.perf_counter()
+    while w.step(max_records=4096):
+        pass
+    lines = out.poll()
+    dt = time.perf_counter() - t0
+    w.close()
+    doc = json.loads(lines[-1])
+    pts = np.asarray(doc["skyline_points"], dtype=np.float32)
+    return dt, pts.tobytes(), int(doc["skyline_size"])
+
+
+def bench_e2e(n: int, d: int, repeats: int) -> dict:
+    from skyline_tpu.resilience import ResilienceConfig
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    rows = anti_correlated(rng, n, d, 0, 10000)
+    off_s, on_s = [], []
+    for _ in range(repeats + 1):  # first round warms the executables
+        base_dt, base_bytes, base_size = _drive(rows, d, None)
+        tmp = tempfile.mkdtemp(prefix="skyline-res-ab-")
+        try:
+            res_dt, res_bytes, res_size = _drive(
+                rows, d,
+                ResilienceConfig(checkpoint_dir=tmp, wal_fsync="off"),
+            )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        assert res_size == base_size and res_bytes == base_bytes, (
+            "crash safety changed the skyline"
+        )
+        off_s.append(base_dt)
+        on_s.append(res_dt)
+    off_ms = float(np.median(off_s[1:]) * 1000.0)
+    on_ms = float(np.median(on_s[1:]) * 1000.0)
+    return {
+        "n": n,
+        "d": d,
+        "off_ms": round(off_ms, 1),
+        "on_ms": round(on_ms, 1),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100.0, 1),
+        "byte_identical": True,
+    }
+
+
+def bench_wal(appends: int = 2000) -> dict:
+    from skyline_tpu.resilience.wal import WalWriter
+
+    rec = {"type": "batch", "lo": 0, "hi": 65536, "digest": "0" * 40}
+    out = {}
+    for policy in ("off", "batch", "always"):
+        count = appends if policy != "always" else max(appends // 10, 100)
+        tmp = tempfile.mkdtemp(prefix=f"skyline-wal-{policy}-")
+        try:
+            w = WalWriter(tmp, fsync=policy)
+            t0 = time.perf_counter()
+            for i in range(count):
+                w.append(rec)
+                if policy == "batch" and i % 16 == 15:  # a step's cadence
+                    w.flush()
+            w.flush(force=True)
+            dt = time.perf_counter() - t0
+            w.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        out[policy] = {
+            "appends": count,
+            "us_per_append": round(dt / count * 1e6, 2),
+            "appends_per_sec": round(count / dt, 0),
+        }
+    return out
+
+
+def bench_ckpt(n: int, d: int) -> dict:
+    from skyline_tpu.resilience.checkpoints import CheckpointManager
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, dims=d, domain_max=10000.0,
+                     buffer_size=max(n, 1024))
+    )
+    ids = np.arange(n, dtype=np.int64)
+    eng.process_records(ids, anti_correlated(rng, n, d, 0, 10000))
+    tmp = tempfile.mkdtemp(prefix="skyline-ckpt-ab-")
+    try:
+        mgr = CheckpointManager(tmp)
+        t0 = time.perf_counter()
+        path = mgr.save(eng, extra_meta={"data_off": n, "query_off": 0})
+        save_ms = (time.perf_counter() - t0) * 1000.0
+        size_kb = os.path.getsize(path) / 1024.0
+        t0 = time.perf_counter()
+        hit = mgr.restore_latest()
+        restore_ms = (time.perf_counter() - t0) * 1000.0
+        assert hit is not None and hit[0].records_in == n
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "n": n,
+        "d": d,
+        "save_ms": round(save_ms, 1),
+        "restore_ms": round(restore_ms, 1),
+        "size_kb": round(size_kb, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="crash-safety overhead A/B")
+    ap.add_argument("--n", type=int, default=20000)
+    ap.add_argument("--d", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "artifacts", "resilience_ab.json")
+    )
+    a = ap.parse_args(argv)
+
+    result = {
+        "hook": bench_hook(),
+        "e2e": bench_e2e(a.n, a.d, a.repeats),
+        "wal": bench_wal(),
+        "ckpt": bench_ckpt(a.n, a.d),
+    }
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print(f"wrote {a.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

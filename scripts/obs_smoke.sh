@@ -270,6 +270,11 @@ if python scripts/bench_compare.py --dir "$REGRESSED"; then
 fi
 echo "[obs-smoke] bench_compare gate ok (pass + forced-regression trip)"
 
+# crash-safety gate: supervised crash/restart cycle lands byte-identical
+# to an uninterrupted run, resilience counters move (RUNBOOK 2i)
+scripts/chaos_smoke.sh
+echo "[obs-smoke] chaos gate ok"
+
 # static-analysis gate: knob registry lint, jaxpr invariant audit,
 # lock-discipline lint, docs/KNOBS.md drift (scripts/lint.sh, RUNBOOK 2h)
 scripts/lint.sh

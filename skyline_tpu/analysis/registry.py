@@ -232,6 +232,44 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_JAX_PROFILE_DIR", "str", "",
        "wrap each forced-query injection in jax.profiler.trace",
        "job flag", runbook="§2b", job_field="jax_profile_dir"),
+    _k("SKYLINE_CHECKPOINT_DIR", "str", "",
+       "enable crash safety: WAL + periodic checkpoints under this "
+       "directory (empty = off)", "job flag", runbook="§2i",
+       job_field="checkpoint_dir"),
+    _k("SKYLINE_CHECKPOINT_INTERVAL_S", "float", 30.0,
+       "seconds between automatic checkpoints (0 = only on clean "
+       "shutdown / manual)", "job flag", runbook="§2i",
+       job_field="checkpoint_interval_s"),
+    _k("SKYLINE_CHECKPOINT_RETAIN", "int", 3,
+       "checkpoints kept on disk (older ones pruned)", "job flag",
+       runbook="§2i", job_field="checkpoint_retain"),
+    _k("SKYLINE_WAL_FSYNC", "enum", "batch",
+       "WAL durability: always (per append), batch (per worker step), "
+       "off (OS page cache only)", "job flag",
+       choices=("always", "batch", "off"), runbook="§2i",
+       job_field="wal_fsync"),
+    _k("SKYLINE_WAL_SEGMENT_BYTES", "int", 4_194_304,
+       "WAL segment rotation size", "job flag", runbook="§2i",
+       job_field="wal_segment_bytes"),
+    # -- resilience runtime (skyline_tpu/resilience) -----------------------
+    _k("SKYLINE_FAULT_PLAN", "str", None,
+       "deterministic fault-injection plan, e.g. crash@flush.pre_merge:3 "
+       "(comma-separated action@point:nth clauses; test/chaos use only)",
+       "resilience", runbook="§2i"),
+    _k("SKYLINE_SUPERVISOR_MAX_RESTARTS", "int", 5,
+       "supervised-restart budget before giving up", "resilience",
+       runbook="§2i"),
+    _k("SKYLINE_SUPERVISOR_BACKOFF_S", "float", 0.5,
+       "base restart backoff (doubles per crash, plus jitter)",
+       "resilience", runbook="§2i"),
+    _k("SKYLINE_SUPERVISOR_BACKOFF_CAP_S", "float", 30.0,
+       "restart backoff ceiling", "resilience", runbook="§2i"),
+    _k("SKYLINE_KAFKA_RETRIES", "int", 5,
+       "kafkalite transport reconnect attempts per request", "bridge",
+       runbook="§2i"),
+    _k("SKYLINE_KAFKA_BACKOFF_S", "float", 0.05,
+       "base kafkalite reconnect backoff (doubles per attempt)", "bridge",
+       runbook="§2i"),
     # -- bench harness (bench.py) ------------------------------------------
     _k("BENCH_N", "int", None,
        "window rows (default 1M on TPU, BENCH_CPU_N on the fallback)",

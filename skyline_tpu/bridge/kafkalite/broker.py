@@ -354,14 +354,26 @@ class Broker:
         host: str = "127.0.0.1",
         port: int = 0,
         max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES,
+        state: "_BrokerState | None" = None,
     ):
+        """``state``: carry an existing ``_BrokerState`` (topic logs) into a
+        new broker instance — the broker-restart half of the client
+        reconnect tests, standing in for Kafka's on-disk log surviving a
+        broker bounce."""
+
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._server = _Server((host, port), _Handler)
-        self._server.state = _BrokerState(max_message_bytes)  # type: ignore[attr-defined]
+        self._server.state = (  # type: ignore[attr-defined]
+            state if state is not None else _BrokerState(max_message_bytes)
+        )
         self._thread: threading.Thread | None = None
+
+    @property
+    def state(self) -> _BrokerState:
+        return self._server.state  # type: ignore[attr-defined]
 
     @property
     def address(self) -> str:

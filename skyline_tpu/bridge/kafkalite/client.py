@@ -32,40 +32,98 @@ class MessageSizeTooLargeError(KafkaLiteError):
     pass
 
 
-class _Connection:
-    """One framed request/response socket with correlation-id matching."""
+class KafkaLiteConnectionError(KafkaLiteError):
+    """The broker connection died (reset, refused, closed mid-frame)."""
 
-    def __init__(self, bootstrap: str, client_id: str, timeout_s: float = 30.0):
+
+class _Connection:
+    """One framed request/response socket with correlation-id matching.
+
+    Transport faults (connection reset, broker restart) are retried with
+    bounded exponential backoff: the socket is torn down, re-dialed, and
+    the request re-sent. Every request in this protocol subset is
+    idempotent except Produce, and the producer's ``flush`` already
+    restores unacked records on error — a duplicate Produce can only
+    happen when the broker acked and the ack was lost in transit, the
+    standard at-least-once window every Kafka client has with retries on.
+    """
+
+    def __init__(
+        self,
+        bootstrap: str,
+        client_id: str,
+        timeout_s: float = 30.0,
+        retries: int | None = None,
+        backoff_s: float | None = None,
+    ):
+        from skyline_tpu.analysis.registry import env_float, env_int
+
         host, _, port = bootstrap.partition(":")
-        self._sock = socket.create_connection(
-            (host, int(port or 9092)), timeout=timeout_s
+        self._addr = (host, int(port or 9092))
+        self._timeout_s = timeout_s
+        self._retries = env_int("SKYLINE_KAFKA_RETRIES", 5) if retries is None else retries
+        self._backoff_s = (
+            env_float("SKYLINE_KAFKA_BACKOFF_S", 0.05)
+            if backoff_s is None else backoff_s
         )
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.reconnects = 0
+        self._sock: socket.socket | None = None
         self.client_id = client_id
         self._corr = 0
         self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def request(self, api_key: int, api_version: int, body: bytes) -> P.Reader:
         with self._lock:
-            self._corr += 1
-            corr = self._corr
-            self._sock.sendall(
-                P.encode_request(api_key, api_version, corr, self.client_id, body)
-            )
-            frame = P.read_frame(self._sock)
-            if frame is None:
-                raise KafkaLiteError("broker closed connection")
-            r = P.Reader(frame)
-            got = r.int32()
-            if got != corr:
-                raise KafkaLiteError(f"correlation mismatch {got} != {corr}")
-            return r
+            last: Exception | None = None
+            for attempt in range(self._retries + 1):
+                if attempt:
+                    time.sleep(self._backoff_s * (2.0 ** (attempt - 1)))
+                    self.reconnects += 1
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._corr += 1
+                    corr = self._corr
+                    self._sock.sendall(
+                        P.encode_request(
+                            api_key, api_version, corr, self.client_id, body
+                        )
+                    )
+                    frame = P.read_frame(self._sock)
+                    if frame is None:
+                        raise KafkaLiteConnectionError("broker closed connection")
+                except (OSError, KafkaLiteConnectionError) as e:
+                    last = e
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    continue
+                r = P.Reader(frame)
+                got = r.int32()
+                if got != corr:
+                    # protocol corruption, not a transport fault: don't retry
+                    raise KafkaLiteError(f"correlation mismatch {got} != {corr}")
+                return r
+            raise KafkaLiteConnectionError(
+                f"broker at {self._addr[0]}:{self._addr[1]} unreachable after "
+                f"{self._retries} retries: {last}"
+            ) from last
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
 
 class KafkaLiteProducer:
@@ -343,6 +401,14 @@ class KafkaLiteConsumer:
         undelivered pending records. This (not ``_offset``) is the value an
         offset commit or position report must use."""
         return self._position() - len(self._pending)
+
+    def seek(self, offset: int) -> None:
+        """Reposition to ``offset`` (consumer-visible coordinates). Drops
+        any decoded-but-undelivered records — after a seek the next poll
+        delivers exactly the record at ``offset``. This is the WAL-replay
+        entry point: resume from the last committed position."""
+        self._pending.clear()
+        self._offset = max(0, int(offset))
 
     def _fetch(self, offset: int, timeout_ms: int) -> list[bytes]:
         """One fetch request at ``offset``; returns the raw RecordBatch

@@ -48,6 +48,14 @@ class MemoryBus:
             self._offsets[(topic, cid)] = off + len(batch)
         return batch
 
+    def _position(self, topic: str, cid: int) -> int:
+        with self._lock:
+            return self._offsets[(topic, cid)]
+
+    def _seek(self, topic: str, cid: int, offset: int) -> None:
+        with self._lock:
+            self._offsets[(topic, cid)] = max(0, int(offset))
+
     def size(self, topic: str) -> int:
         with self._lock:
             return len(self._topics[topic])
@@ -61,3 +69,12 @@ class MemoryConsumer:
 
     def poll(self, max_records: int = 65536) -> list[str]:
         return self._bus._poll(self.topic, self._cid, max_records)
+
+    def position(self) -> int:
+        """Offset of the next record this consumer will receive — same
+        contract as ``KafkaLiteConsumer.position`` (the resilience layer's
+        commit/replay currency)."""
+        return self._bus._position(self.topic, self._cid)
+
+    def seek(self, offset: int) -> None:
+        return self._bus._seek(self.topic, self._cid, offset)

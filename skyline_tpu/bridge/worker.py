@@ -9,10 +9,13 @@ and produces one JSON result per completed query on the output topic
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 from skyline_tpu.bridge.wire import format_result, parse_tuple_lines
+from skyline_tpu.resilience.faults import fault_point, install_from_env
+from skyline_tpu.resilience.wal import batch_digest
 from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
 
 # Reference topic names (FlinkSkyline.java:68-70)
@@ -42,6 +45,7 @@ class SkylineWorker:
         trace_ring: int = 4096,
         trace_out: str | None = None,
         jax_profile_dir: str | None = None,
+        resilience=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``stats_port``: serve
@@ -72,7 +76,15 @@ class SkylineWorker:
         ``jax_profile_dir``: opt-in — wrap each forced-query injection
         (POST /query) in ``jax.profiler.trace`` writing to this directory,
         so a device-level profile of exactly one consistency merge can be
-        captured from a live worker."""
+        captured from a live worker.
+        ``resilience``: a ``resilience.ResilienceConfig`` enabling crash
+        safety — on construction the worker restores the newest valid
+        checkpoint, replays the WAL (digest-verified, exactly the committed
+        spans) to the crashed incarnation's exact position, re-seats the
+        serving plane's snapshot + delta ring, then records every consumed
+        span and published delta to a fresh WAL segment; periodic
+        checkpoints truncate the log. None (default) keeps the reference's
+        lose-everything behavior."""
         from skyline_tpu.metrics.tracing import Tracer
         from skyline_tpu.telemetry import Telemetry
 
@@ -92,6 +104,53 @@ class SkylineWorker:
         # (ids, values) tail of an oversized array batch, served in
         # max_records micro-batches by subsequent _poll_data calls
         self._data_carry: tuple | None = None
+        # -- crash safety (resilience=None keeps all of this inert) -------
+        self.resilience = resilience
+        self._ckpt_mgr = None
+        self._wal = None
+        self._snap_store = None
+        self._serve_ring = None
+        self._data_pos = 0  # consumed data-topic records (replay currency)
+        self._query_pos = 0  # consumed query-topic records
+        self._dirty = False  # work since the last checkpoint
+        self._last_ckpt_s = time.monotonic()
+        self._stop_requested = False
+        self._recovered: dict | None = None
+        restored_engine = None
+        restored_meta = None
+        wal_records: list = []
+        wal_torn = 0
+        if resilience is not None:
+            if window_size:
+                raise ValueError(
+                    "sliding-window mode does not support crash safety "
+                    "(utils/checkpoint.py covers the tumbling engine only)"
+                )
+            install_from_env()  # arm SKYLINE_FAULT_PLAN (parse-once)
+            from skyline_tpu.resilience import WAL_SUBDIR
+            from skyline_tpu.resilience.checkpoints import CheckpointManager
+            from skyline_tpu.resilience.wal import read_records
+
+            self._ckpt_mgr = CheckpointManager(
+                resilience.checkpoint_dir,
+                retain=resilience.checkpoint_retain,
+                telemetry=self.telemetry,
+            )
+            hit = self._ckpt_mgr.restore_latest(
+                mesh=mesh, tracer=self.tracer, telemetry=self.telemetry
+            )
+            ckpt_path = None
+            if hit is not None:
+                restored_engine, restored_meta, ckpt_path = hit
+            self._wal_dir = os.path.join(resilience.checkpoint_dir, WAL_SUBDIR)
+            wal_records, wal_torn = read_records(self._wal_dir)
+            if hit is not None or wal_records:
+                self._recovered = {
+                    "checkpoint": ckpt_path,
+                    "wal_records": len(wal_records),
+                    "wal_torn_segments": wal_torn,
+                    "replayed_batches": 0,
+                }
         if window_size:
             from skyline_tpu.stream.sliding_engine import SlidingEngine
 
@@ -104,6 +163,11 @@ class SkylineWorker:
                 tracer=self.tracer,
                 telemetry=self.telemetry,
             )
+        elif restored_engine is not None:
+            # the checkpoint carries its full EngineConfig; trust it over the
+            # passed config so a restarted incarnation can't silently change
+            # result semantics mid-stream
+            self.engine = restored_engine
         else:
             self.engine = SkylineEngine(
                 config, mesh=mesh, tracer=self.tracer, telemetry=self.telemetry
@@ -112,6 +176,8 @@ class SkylineWorker:
         self._data = bus.consumer(input_topic, from_beginning=True)
         self._queries = bus.consumer(query_topic, from_beginning=False)
         self.results_emitted = 0
+        if resilience is not None:
+            self._replay(restored_meta, wal_records)
         self.serve_server = None
         self._serve_bridge = None
         if serve_port is not None:
@@ -128,6 +194,8 @@ class SkylineWorker:
             ring = DeltaRing(store, capacity=scfg.delta_ring)
             self.engine.attach_snapshots(store)
             self._serve_bridge = QueryBridge()
+            self._snap_store = store
+            self._serve_ring = ring
             try:
                 self.serve_server = SkylineServer(
                     store,
@@ -145,11 +213,36 @@ class SkylineWorker:
                 # conflict must not take the ingest plane down
                 self.engine.snapshots = None
                 self._serve_bridge = None
+                self._snap_store = None
+                self._serve_ring = None
                 print(
                     f"skyline worker: serve port {serve_port} unavailable "
                     f"({e}); continuing without the serving plane",
                     file=sys.stderr,
                 )
+        if resilience is not None:
+            if self._snap_store is not None:
+                self._restore_serve(wal_records)
+            from skyline_tpu.resilience.wal import WalWriter
+
+            self._wal = WalWriter(
+                self._wal_dir,
+                segment_bytes=resilience.wal_segment_bytes,
+                fsync=resilience.wal_fsync,
+                telemetry=self.telemetry,
+            )
+            # subscribe AFTER the serve restore so re-seating the head never
+            # logs a bogus everything-entered delta
+            if self._snap_store is not None:
+                self._snap_store.on_publish(self._wal_on_publish)
+            self._wal.append(
+                {
+                    "type": "start",
+                    "data_off": self._data_pos,
+                    "query_off": self._query_pos,
+                }
+            )
+            self._wal.flush(force=True)
         self.stats_server = None
         if stats_port is not None:
             from skyline_tpu.metrics.httpstats import StatsServer
@@ -180,6 +273,17 @@ class SkylineWorker:
         if self.serve_server is not None:
             out["serve"] = self.serve_server.admission.stats()
             out["snapshot_store"] = self.serve_server.store.stats()
+        if self._ckpt_mgr is not None:
+            res = {
+                "checkpoint": self._ckpt_mgr.stats(),
+                "data_off": self._data_pos,
+                "query_off": self._query_pos,
+            }
+            if self._wal is not None:
+                res["wal"] = self._wal.stats()
+            if self._recovered is not None:
+                res["recovered"] = self._recovered
+            out["resilience"] = res
         return out
 
     def close(self) -> None:
@@ -203,6 +307,285 @@ class SkylineWorker:
             self.stats_server.close()
         if self.serve_server is not None:
             self.serve_server.close()
+        if self._wal is not None:
+            try:
+                self._wal.close()
+            except OSError:
+                pass
+            self._wal = None
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _replay(self, meta: dict | None, records: list) -> None:
+        """Rebuild the exact pre-crash ingest state: seek the data consumer
+        to the checkpoint's committed offset, then re-ingest every WAL
+        ``batch`` span (poll exactly ``hi - lo`` records, digest-verified)
+        in the same per-call chunks the crashed incarnation used — with the
+        restored engine as the base, the post-replay state is byte-identical
+        to the uninterrupted run's at the same offset. The query consumer is
+        re-seated to the last committed position so triggers that were
+        polled but whose step never committed are re-polled (at-least-once
+        trigger processing over exactly-once state)."""
+        import numpy as np
+
+        from skyline_tpu.resilience.wal import WalReplayError, batch_digest
+
+        data_base = 0
+        query_off = None
+        if meta is not None:
+            extra = meta.get("extra", {})
+            data_base = int(extra.get("data_off", 0))
+            if "query_off" in extra:
+                query_off = int(extra["query_off"])
+        for rec in records:
+            if rec.get("type") in ("start", "commit", "ckpt") and "query_off" in rec:
+                query_off = int(rec["query_off"])
+        if meta is None and not records:
+            # first boot: anchor the positions (notably the query topic's
+            # latest-reset offset, which only exists as a live position now)
+            self._data_pos = self._pos_of(self._data)
+            self._query_pos = self._pos_of(self._queries)
+            return
+        self._seek(self._data, data_base)
+        pos = data_base
+        replayed = 0
+        dims = self.engine.config.dims
+        for rec in records:
+            if rec.get("type") != "batch":
+                continue
+            lo, hi, digest = int(rec["lo"]), int(rec["hi"]), rec["digest"]
+            if hi <= data_base:
+                continue  # already folded into the restored checkpoint
+            if lo < data_base:
+                raise WalReplayError(
+                    f"batch span [{lo},{hi}) straddles checkpoint offset "
+                    f"{data_base}"
+                )
+            if lo != pos:
+                raise WalReplayError(
+                    f"gap in WAL: expected a batch at offset {pos}, "
+                    f"found [{lo},{hi})"
+                )
+            need = hi - lo
+            got_total, dropped = 0, 0
+            ids_parts: list = []
+            val_parts: list = []
+            while got_total < need:
+                ids, values, dr, got = self._poll_data(need - got_total)
+                if got == 0:
+                    raise WalReplayError(
+                        f"bus ended at offset {pos + got_total} while "
+                        f"replaying to {hi}"
+                    )
+                got_total += got
+                dropped += dr
+                if ids.shape[0]:
+                    ids_parts.append(ids)
+                    val_parts.append(values)
+            if got_total != need:
+                raise WalReplayError(
+                    f"replay chunk misalignment: span [{lo},{hi}) yielded "
+                    f"{got_total} records"
+                )
+            ids = (
+                np.concatenate(ids_parts)
+                if ids_parts else np.empty(0, dtype=np.int64)
+            )
+            values = (
+                np.concatenate(val_parts)
+                if val_parts else np.empty((0, dims), dtype=np.float32)
+            )
+            if batch_digest(ids, values) != digest:
+                self.telemetry.inc("wal.digest_mismatch")
+                raise WalReplayError(
+                    f"replay digest mismatch for span [{lo},{hi}): the bus "
+                    "does not hold the bytes the WAL committed"
+                )
+            self.engine.dropped += dropped
+            if ids.shape[0]:
+                self.engine.process_records(ids, values)
+            pos = hi
+            replayed += 1
+            self.telemetry.inc("wal.replayed")
+        self._data_pos = pos
+        if query_off is not None:
+            self._seek(self._queries, query_off)
+            self._query_pos = query_off
+        else:
+            self._query_pos = self._pos_of(self._queries)
+        if self._recovered is not None:
+            self._recovered["replayed_batches"] = replayed
+        if replayed or meta is not None:
+            print(
+                f"skyline worker: recovered — checkpoint "
+                f"{'yes' if meta is not None else 'no'}, replayed {replayed} "
+                f"WAL batch(es) to data offset {pos}",
+                file=sys.stderr,
+            )
+
+    @staticmethod
+    def _seek(consumer, offset: int) -> None:
+        seek = getattr(consumer, "seek", None)
+        if seek is None:
+            raise RuntimeError(
+                "crash safety requires a seekable consumer (MemoryBus or "
+                f"kafkalite); {type(consumer).__name__} has no seek()"
+            )
+        seek(offset)
+
+    @staticmethod
+    def _pos_of(consumer) -> int:
+        position = getattr(consumer, "position", None)
+        return int(position()) if position is not None else 0
+
+    def _restore_serve(self, records: list) -> None:
+        """Re-seat the serving plane from the WAL: head points from the last
+        checkpoint barrier's inlined snapshot plus every delta after it
+        (set-exact; the next live publish restores canonical byte order),
+        the delta ring from the same delta records, version numbering
+        continuous. Until a live publish lands, reads carry
+        ``"restored": true``."""
+        import numpy as np
+
+        from skyline_tpu.resilience.wal import rows_from_b64
+        from skyline_tpu.serve.deltas import Delta, _row_keys
+
+        base = None
+        base_idx = -1
+        for i, rec in enumerate(records):
+            if rec.get("type") == "ckpt" and "snap" in rec:
+                base, base_idx = rec["snap"], i
+        delta_recs = [
+            r for r in records[base_idx + 1 :] if r.get("type") == "delta"
+        ]
+        if base is None and not delta_recs:
+            return
+        d = int(base["d"] if base is not None else delta_recs[0]["d"])
+        points = (
+            rows_from_b64(base["rows"], d)
+            if base is not None
+            else np.empty((0, d), dtype=np.float32)
+        )
+        version = int(base["version"]) if base is not None else 0
+        watermark = int(base.get("watermark_id", -1)) if base is not None else -1
+        ring_deltas = []
+        for rec in delta_recs:
+            entered = rows_from_b64(rec["entered"], int(rec["d"]))
+            left = rows_from_b64(rec["left"], int(rec["d"]))
+            ring_deltas.append(
+                Delta(int(rec["from"]), int(rec["to"]), entered, left)
+            )
+            if left.shape[0] and points.shape[0]:
+                points = points[~np.isin(_row_keys(points), _row_keys(left))]
+            if entered.shape[0]:
+                points = (
+                    np.concatenate([points, entered])
+                    if points.shape[0] else entered
+                )
+            version = int(rec["to"])
+            watermark = int(rec.get("wm", watermark))
+        self._snap_store.restore_state(points, version, watermark_id=watermark)
+        if self._serve_ring is not None:
+            self._serve_ring.seed(ring_deltas, version)
+        print(
+            f"skyline worker: serving plane restored at version {version} "
+            f"({points.shape[0]} point(s), {len(ring_deltas)} delta(s))",
+            file=sys.stderr,
+        )
+
+    def _wal_on_publish(self, prev, snap) -> None:
+        """Persist each published snapshot transition so ``/deltas``
+        subscribers survive a restart (the delta ring's WAL shadow)."""
+        if self._wal is None:
+            return
+        import numpy as np
+
+        from skyline_tpu.resilience.wal import rows_to_b64
+        from skyline_tpu.serve.deltas import snapshot_delta
+
+        entered, left = snapshot_delta(
+            prev.points
+            if prev is not None
+            else np.empty((0, snap.points.shape[1]), dtype=np.float32),
+            snap.points,
+        )
+        self._wal.append(
+            {
+                "type": "delta",
+                "from": prev.version if prev is not None else 0,
+                "to": snap.version,
+                "wm": snap.watermark_id,
+                "d": int(snap.points.shape[1]),
+                "entered": rows_to_b64(entered),
+                "left": rows_to_b64(left),
+            }
+        )
+
+    def _barrier_record(self) -> dict:
+        rec = {
+            "type": "ckpt",
+            "data_off": self._data_pos,
+            "query_off": self._query_pos,
+        }
+        snap = (
+            self._snap_store.latest() if self._snap_store is not None else None
+        )
+        if snap is not None:
+            from skyline_tpu.resilience.wal import rows_to_b64
+
+            rec["snap"] = {
+                "version": snap.version,
+                "watermark_id": snap.watermark_id,
+                "timestamp_ms": snap.timestamp_ms,
+                "d": int(snap.points.shape[1]),
+                "rows": rows_to_b64(snap.points),
+            }
+        return rec
+
+    def checkpoint_now(self) -> str | None:
+        """Atomic checkpoint + WAL barrier (rotate, log the serve head,
+        truncate everything the checkpoint now covers)."""
+        if self._ckpt_mgr is None:
+            return None
+        path = self._ckpt_mgr.save(
+            self.engine,
+            extra_meta={
+                "data_off": self._data_pos,
+                "query_off": self._query_pos,
+            },
+        )
+        if self._wal is not None:
+            self._wal.barrier(self._barrier_record())
+        self._last_ckpt_s = time.monotonic()
+        self._dirty = False
+        return path
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt_mgr is None or not self._dirty:
+            return
+        interval = self.resilience.checkpoint_interval_s
+        if interval <= 0:  # shutdown/manual-only mode
+            return
+        if time.monotonic() - self._last_ckpt_s >= interval:
+            self.checkpoint_now()
+
+    def shutdown(self) -> None:
+        """Clean exit (SIGTERM/SIGINT): final checkpoint, force-fsync the
+        WAL, close every server — a restart from this state replays
+        nothing and loses nothing."""
+        if self._ckpt_mgr is not None and self._dirty:
+            self.checkpoint_now()
+        if self._wal is not None:
+            self._wal.flush(force=True)
+        self.close()
+
+    def _signal_handler(self, signum, frame) -> None:
+        self._stop_requested = True
+        print(
+            f"skyline worker: signal {signum} received; finishing the "
+            "current step then checkpointing",
+            file=sys.stderr,
+        )
 
     def _poll_data(self, max_records: int):
         """One data-topic poll as ``(ids, values, dropped, got)`` where
@@ -290,12 +673,27 @@ class SkylineWorker:
         heuristic (FlinkSkyline.java:351) for a partition that got nothing
         in ``max_drain_polls * max_records`` drained rows.
         """
+        fault_point("kafka.poll")
         with self.tracer.phase("worker/poll"):
             triggers = self._queries.poll(max_records)
             ids, values, dropped, got = self._poll_data(max_records)
+        self._query_pos += len(triggers)
         total_lines = 0
         drains = 0
         while got:
+            if self._wal is not None:
+                # the span is logged BEFORE ingest: a crash inside the merge
+                # replays it; in-memory effects of the crashed attempt are
+                # discarded wholesale, so state stays exactly-once
+                self._wal.append(
+                    {
+                        "type": "batch",
+                        "lo": self._data_pos,
+                        "hi": self._data_pos + got,
+                        "digest": batch_digest(ids, values),
+                    }
+                )
+            self._data_pos += got
             total_lines += got
             self.engine.dropped += dropped
             if ids.shape[0]:
@@ -338,7 +736,22 @@ class SkylineWorker:
             self.bus.produce(self.output_topic, format_result(result))
             self.results_emitted += 1
             self._report_phases()
-        return total_lines + len(triggers)
+        work = total_lines + len(triggers)
+        if work and self._wal is not None:
+            # the step's durability point: positions commit (and, under the
+            # batch fsync policy, everything above reaches the platter)
+            self._wal.append(
+                {
+                    "type": "commit",
+                    "data_off": self._data_pos,
+                    "query_off": self._query_pos,
+                }
+            )
+            self._wal.flush()
+        if work:
+            self._dirty = True
+        self._maybe_checkpoint()
+        return work
 
     def _inject_serve_queries(self) -> None:
         """Run the serve-plane's queued forced merges; with
@@ -385,10 +798,33 @@ class SkylineWorker:
             print(f"skyline worker: phase_breakdown_ms={delta}",
                   file=sys.stderr, flush=True)
 
-    def run_forever(self, idle_sleep_s: float = 0.01, stop_after_idle_s: float | None = None):
-        """Poll loop; optionally exits after ``stop_after_idle_s`` of silence."""
+    def run_forever(
+        self,
+        idle_sleep_s: float = 0.01,
+        stop_after_idle_s: float | None = None,
+        install_signal_handlers: bool | None = None,
+    ):
+        """Poll loop; optionally exits after ``stop_after_idle_s`` of silence.
+
+        With crash safety on (and by default only then), SIGTERM/SIGINT are
+        handled gracefully: the current step finishes, a final checkpoint +
+        WAL fsync land, the servers close, and the loop returns — a restart
+        from that state replays nothing and loses nothing."""
+        if install_signal_handlers is None:
+            install_signal_handlers = self.resilience is not None
+        if install_signal_handlers:
+            import signal
+
+            try:
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    signal.signal(sig, self._signal_handler)
+            except ValueError:
+                pass  # not the main thread (embedded runs): flag-only stop
         idle_since = None
         while True:
+            if self._stop_requested:
+                self.shutdown()
+                return
             n = self.step()
             if n == 0:
                 now = time.time()
@@ -430,12 +866,14 @@ def main(argv=None):
         trace_ring=cfg.trace_ring,
         trace_out=cfg.trace_out or None,
         jax_profile_dir=cfg.jax_profile_dir or None,
+        resilience=cfg.resilience_config(),
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
         f"dims={cfg.dims} broker={cfg.bootstrap} mesh={cfg.mesh or 'off'}"
         + (f" stats=:{worker.stats_server.port}" if worker.stats_server else "")
-        + (f" serve=:{worker.serve_server.port}" if worker.serve_server else ""),
+        + (f" serve=:{worker.serve_server.port}" if worker.serve_server else "")
+        + (f" checkpoints={cfg.checkpoint_dir}" if cfg.checkpoint_dir else ""),
         file=sys.stderr,
     )
     try:

@@ -1,0 +1,45 @@
+"""Crash safety: WAL + auto-checkpoint + supervised restart.
+
+The reference wires all operator state as Flink managed state but never
+enables checkpointing — a crash loses everything (SURVEY.md §5, "the
+mechanism is wired, the feature is off"). This package turns the feature
+on, and makes recovery a *provable* property rather than a best-effort
+one: the merge law ("Computing Skylines on Distributed Data",
+arxiv 1611.00423) guarantees that re-ingesting a replayed stream suffix
+into a restored partition state reproduces the uninterrupted run's
+skyline byte-for-byte, so the chaos harness (tests/test_resilience.py)
+asserts bit-identical final results across injected crashes.
+
+Pieces (each importable on its own; this ``__init__`` stays stdlib-only
+because ``stream/batched.py`` imports ``faults`` on its hot path):
+
+- ``faults``      deterministic fault-injection registry (named kill
+                  points, ``SKYLINE_FAULT_PLAN``)
+- ``wal``         CRC32-framed, segment-rotated append-only log of
+                  consumed offsets + batch digests + published deltas
+- ``checkpoints`` retain-N checkpoint manager with CRC-verified restore
+                  and fallback to the previous good checkpoint
+- ``supervisor``  exponential-backoff restart loop with a bounded budget
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+WAL_SUBDIR = "wal"  # WAL segments live under <checkpoint_dir>/wal
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """The worker's durability knobs (built by JobConfig.resilience_config;
+    an empty ``checkpoint_dir`` means resilience is off and none of the
+    other fields matter)."""
+
+    checkpoint_dir: str
+    checkpoint_interval_s: float = 30.0  # 0 = shutdown/manual only
+    checkpoint_retain: int = 3
+    wal_fsync: str = "batch"  # always | batch (per step) | off
+    wal_segment_bytes: int = 4_194_304
+
+
+__all__ = ["ResilienceConfig", "WAL_SUBDIR"]

@@ -1,0 +1,152 @@
+"""Deterministic fault injection: named kill points compiled into the hot
+paths as near-zero-cost no-ops when disabled.
+
+Each instrumented site calls ``fault_point("<name>")``; with no plan
+installed that is one global load and a ``None`` check. A plan — parsed
+from ``SKYLINE_FAULT_PLAN`` (e.g. ``crash@flush.pre_merge:3``, clauses
+comma-separated) or installed programmatically by the chaos harness —
+counts hits per point and raises ``InjectedCrash`` when a clause's hit
+number comes up. Hit counting is global and monotonic across in-process
+worker incarnations, and each clause fires exactly once, so a plan like
+``crash@flush.pre_merge:3,crash@kafka.poll:9`` describes a bounded,
+reproducible crash schedule: given the same stream, the same crashes
+happen at the same points every run.
+
+``InjectedCrash`` subclasses ``BaseException`` deliberately: an injected
+crash models a process death, so no ``except Exception`` recovery path in
+the product tree may swallow it — only the supervisor (or the test
+harness) catches it.
+"""
+
+from __future__ import annotations
+
+import os
+
+# every instrumented site, so a typo'd plan fails at parse time instead of
+# silently never firing
+KILL_POINTS = frozenset(
+    (
+        "flush.pre_merge",  # stream/batched.py flush_all entry
+        "wal.pre_fsync",  # resilience/wal.py before os.fsync
+        "wal.post_append",  # resilience/wal.py after a frame lands
+        "checkpoint.pre_replace",  # utils/checkpoint.py before os.replace
+        "snapshot.publish",  # serve/snapshot.py publish entry
+        "kafka.poll",  # bridge/worker.py step() poll entry
+    )
+)
+
+_ACTIONS = ("crash", "exit")
+
+
+class InjectedCrash(BaseException):
+    """A simulated process death (see module docstring for why this is a
+    BaseException)."""
+
+
+class FaultClause:
+    """One ``action@point:nth`` clause; fires once, then stays disarmed."""
+
+    __slots__ = ("action", "point", "nth", "fired")
+
+    def __init__(self, action: str, point: str, nth: int):
+        if action not in _ACTIONS:
+            raise ValueError(f"fault action must be one of {_ACTIONS}, got {action!r}")
+        if point not in KILL_POINTS:
+            raise ValueError(
+                f"unknown kill point {point!r}; known: {sorted(KILL_POINTS)}"
+            )
+        if nth < 1:
+            raise ValueError(f"fault hit number must be >= 1, got {nth}")
+        self.action = action
+        self.point = point
+        self.nth = nth
+        self.fired = False
+
+    def __repr__(self):
+        return f"{self.action}@{self.point}:{self.nth}"
+
+
+class FaultPlan:
+    """A parsed fault plan: per-point hit counters + one-shot clauses."""
+
+    def __init__(self, clauses):
+        self.clauses = list(clauses)
+        self.hits: dict[str, int] = {}
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``crash@flush.pre_merge:3,exit@kafka.poll:7`` -> FaultPlan.
+        The action defaults to ``crash`` when omitted (``flush.pre_merge:3``)."""
+        clauses = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            action, sep, rest = part.partition("@")
+            if not sep:
+                action, rest = "crash", part
+            point, sep, nth_s = rest.partition(":")
+            if not sep:
+                raise ValueError(
+                    f"bad fault clause {part!r}: expected action@point:nth"
+                )
+            clauses.append(FaultClause(action, point, int(nth_s)))
+        if not clauses:
+            raise ValueError(f"empty fault plan {spec!r}")
+        return cls(clauses)
+
+    def hit(self, point: str) -> None:
+        n = self.hits.get(point, 0) + 1
+        self.hits[point] = n
+        for c in self.clauses:
+            if c.point == point and not c.fired and c.nth == n:
+                c.fired = True
+                if c.action == "exit":
+                    os._exit(86)  # a hard process death, no unwinding
+                raise InjectedCrash(f"injected crash at {point} (hit {n})")
+
+    def exhausted(self) -> bool:
+        return all(c.fired for c in self.clauses)
+
+    def __repr__(self):
+        return f"FaultPlan({','.join(map(repr, self.clauses))})"
+
+
+_PLAN: FaultPlan | None = None
+
+
+def fault_point(point: str) -> None:
+    """THE hot-path hook. With no plan installed this is one global load
+    and a None check — see benchmarks/resilience.py for the measured cost."""
+    plan = _PLAN
+    if plan is not None:
+        plan.hit(point)
+
+
+def install_plan(plan: FaultPlan | None) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def active_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def clear() -> None:
+    install_plan(None)
+
+
+def install_from_env() -> FaultPlan | None:
+    """Install the ``SKYLINE_FAULT_PLAN`` plan if one is set and none is
+    active yet. Parse-once semantics: an already-installed plan keeps its
+    hit counters and fired flags across in-process worker restarts (the
+    whole point — each clause kills exactly one incarnation)."""
+    global _PLAN
+    if _PLAN is not None:
+        return _PLAN
+    from skyline_tpu.analysis.registry import env_str
+
+    spec = env_str("SKYLINE_FAULT_PLAN")
+    if spec:
+        _PLAN = FaultPlan.parse(spec)
+    return _PLAN

@@ -1,0 +1,252 @@
+"""CRC32-framed, segment-rotated write-ahead log for the worker.
+
+What goes in (one JSON record per frame):
+
+- ``batch``  {lo, hi, digest}: a consumed data-topic span that the engine
+  ingested, with a sha1 over the parsed (ids, values) arrays. Replay polls
+  exactly ``hi - lo`` records from the committed offset and verifies the
+  digest, so recovery can prove the re-ingested suffix is byte-identical
+  to what the crashed incarnation saw (no duplicate, no lost tuples).
+- ``commit`` {data_off, query_off}: consumed positions at a step boundary.
+- ``delta``  a published snapshot transition (entered/left rows, base64 of
+  the float32 bytes) — this is what persists the serve plane's
+  ``DeltaRing`` across restarts.
+- ``ckpt``   the checkpoint barrier: consumed offsets + the serving head
+  snapshot inlined, written to a FRESH segment after every checkpoint
+  save; all older segments are then deleted (truncation).
+- ``start``  positions at worker construction (anchors the query topic's
+  latest-reset offset for replay).
+
+Frame format: ``<u32 len><u32 crc32(payload)>`` + payload. Appends go
+through one unbuffered ``os.write`` per frame, so an abandoned writer (the
+in-process crash model, and a real SIGKILL) loses at most the frame being
+written — never a previously returned append. ``fsync`` policy:
+``always`` (per append), ``batch`` (per worker step, via ``flush()``), or
+``off`` (OS page cache only — still crash-safe against process death,
+not against power loss).
+
+The reader tolerates a torn tail: replay stops cleanly at the first short
+or CRC-mismatching frame and reports how many segments were cut short.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import struct
+import sys
+import zlib
+
+from skyline_tpu.resilience.faults import fault_point
+
+_SEGMENT_MAGIC = b"SKWL1\n"
+_FRAME = struct.Struct("<II")  # payload length, crc32(payload)
+_SEGMENT_FMT = "wal-%08d.log"
+FSYNC_POLICIES = ("always", "batch", "off")
+
+
+class WalError(Exception):
+    pass
+
+
+class WalReplayError(WalError):
+    """Recovery found the WAL and the bus in disagreement (gap in the
+    recorded spans, bus ended early, or a replay digest mismatch)."""
+
+
+def batch_digest(ids, values) -> str:
+    """Content hash of one parsed ingest batch — the replay-equivalence
+    currency (order-sensitive, dtype-pinned)."""
+    import numpy as np
+
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(ids, dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(values, dtype=np.float32).tobytes())
+    return h.hexdigest()
+
+
+def rows_to_b64(rows) -> str:
+    import numpy as np
+
+    return base64.b64encode(
+        np.ascontiguousarray(rows, dtype=np.float32).tobytes()
+    ).decode("ascii")
+
+
+def rows_from_b64(s: str, dims: int):
+    import numpy as np
+
+    buf = base64.b64decode(s.encode("ascii"))
+    return np.frombuffer(buf, dtype=np.float32).reshape(-1, max(dims, 1)).copy()
+
+
+def _segment_seq(name: str) -> int | None:
+    if name.startswith("wal-") and name.endswith(".log"):
+        try:
+            return int(name[4:-4])
+        except ValueError:
+            return None
+    return None
+
+
+def list_segments(directory: str) -> list[tuple[int, str]]:
+    """(seq, path) of every WAL segment, ascending."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        seq = _segment_seq(n)
+        if seq is not None:
+            out.append((seq, os.path.join(directory, n)))
+    out.sort()
+    return out
+
+
+class WalWriter:
+    """Single-threaded appender (the worker's ingest thread owns it)."""
+
+    def __init__(
+        self,
+        directory: str,
+        segment_bytes: int = 4_194_304,
+        fsync: str = "batch",
+        telemetry=None,
+    ):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"fsync must be one of {FSYNC_POLICIES}, got {fsync!r}")
+        self.directory = directory
+        self.segment_bytes = max(int(segment_bytes), len(_SEGMENT_MAGIC) + 1)
+        self.fsync_policy = fsync
+        self._telemetry = telemetry
+        self.appends = 0
+        self.segments_created = 0
+        self.segments_truncated = 0
+        self._fd: int | None = None
+        self._seg_seq = 0
+        self._seg_bytes = 0
+        self._dirty = False  # frames written since the last fsync
+        os.makedirs(directory, exist_ok=True)
+        existing = list_segments(directory)
+        # a fresh segment per writer: never append into a segment a crashed
+        # incarnation may have left torn
+        self._open_segment((existing[-1][0] + 1) if existing else 1)
+
+    def _open_segment(self, seq: int) -> None:
+        if self._fd is not None:
+            self._fsync_if(self.fsync_policy != "off")
+            os.close(self._fd)
+        path = os.path.join(self.directory, _SEGMENT_FMT % seq)
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        os.write(self._fd, _SEGMENT_MAGIC)
+        self._seg_seq = seq
+        self._seg_bytes = len(_SEGMENT_MAGIC)
+        self.segments_created += 1
+
+    def append(self, rec: dict) -> None:
+        payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+        os.write(self._fd, frame)  # unbuffered: one write syscall per frame
+        self._seg_bytes += len(frame)
+        self._dirty = True
+        fault_point("wal.post_append")
+        self.appends += 1
+        if self._telemetry is not None:
+            self._telemetry.inc("wal.appends")
+        if self.fsync_policy == "always":
+            self._fsync()
+        if self._seg_bytes >= self.segment_bytes:
+            self._open_segment(self._seg_seq + 1)
+
+    def flush(self, force: bool = False) -> None:
+        """The per-step durability point under the ``batch`` policy
+        (``force=True``: fsync regardless of policy — the shutdown path)."""
+        self._fsync_if(force or self.fsync_policy == "batch")
+
+    def _fsync_if(self, cond: bool) -> None:
+        if cond and self._dirty and self._fd is not None:
+            self._fsync()
+
+    def _fsync(self) -> None:
+        fault_point("wal.pre_fsync")
+        os.fsync(self._fd)
+        self._dirty = False
+
+    def barrier(self, rec: dict) -> None:
+        """Checkpoint barrier: rotate to a fresh segment, write ``rec``
+        (type ``ckpt``) as its first record, fsync it (always — the
+        truncation below deletes the only other copy of the serve head),
+        then delete every older segment. After a barrier the WAL's whole
+        content is: the barrier record + everything after the checkpoint."""
+        self._open_segment(self._seg_seq + 1)
+        keep = self._seg_seq
+        self.append(rec)
+        self._fsync()
+        for seq, path in list_segments(self.directory):
+            if seq < keep:
+                try:
+                    os.unlink(path)
+                except OSError as e:  # pragma: no cover - fs race
+                    print(f"wal: could not truncate {path}: {e}", file=sys.stderr)
+                    continue
+                self.segments_truncated += 1
+                if self._telemetry is not None:
+                    self._telemetry.inc("wal.truncated")
+
+    def close(self) -> None:
+        if self._fd is not None:
+            self._fsync_if(self.fsync_policy != "off")
+            os.close(self._fd)
+            self._fd = None
+
+    def stats(self) -> dict:
+        return {
+            "appends": self.appends,
+            "segment_seq": self._seg_seq,
+            "segment_bytes": self._seg_bytes,
+            "segments_created": self.segments_created,
+            "segments_truncated": self.segments_truncated,
+            "fsync_policy": self.fsync_policy,
+        }
+
+
+def read_records(directory: str) -> tuple[list[dict], int]:
+    """Replay every intact record, oldest first. Returns ``(records,
+    torn)`` where ``torn`` counts segments cut short by a bad header,
+    short frame, or CRC mismatch. Reading stops entirely at the first
+    tear — records physically after a tear are not trustworthy in
+    sequence (only the final segment of a crashed run can legitimately
+    be torn, and it is by definition last)."""
+    records: list[dict] = []
+    torn = 0
+    for _seq, path in list_segments(directory):
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[: len(_SEGMENT_MAGIC)] != _SEGMENT_MAGIC:
+            torn += 1
+            break
+        pos = len(_SEGMENT_MAGIC)
+        ok = True
+        while pos < len(data):
+            if pos + _FRAME.size > len(data):
+                ok = False
+                break
+            length, crc = _FRAME.unpack_from(data, pos)
+            start = pos + _FRAME.size
+            payload = data[start : start + length]
+            if len(payload) != length or zlib.crc32(payload) != crc:
+                ok = False
+                break
+            try:
+                records.append(json.loads(payload.decode("utf-8")))
+            except ValueError:
+                ok = False
+                break
+            pos = start + length
+        if not ok:
+            torn += 1
+            break
+    return records, torn

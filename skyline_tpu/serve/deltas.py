@@ -99,6 +99,16 @@ class DeltaRing:
             )
             self.head_version = snap.version
 
+    def seed(self, deltas, head_version: int) -> None:
+        """Replace the ring's content with recovered transitions (WAL
+        ``delta`` records) — the restart half of delta persistence: a
+        subscriber holding a pre-crash version keeps catching up through
+        ``since`` as if the process never died."""
+        with self._lock:
+            self._ring.clear()
+            self._ring.extend(deltas)
+            self.head_version = max(int(head_version), 0)
+
     @property
     def oldest_since(self) -> int | None:
         """The smallest ``since`` the ring can still answer (None = empty)."""

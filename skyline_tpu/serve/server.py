@@ -452,6 +452,10 @@ class SkylineServer:
         )
         if refresh_triggered:
             tail += ', "refresh_triggered": true'
+        if self.store.restored:
+            # head was rebuilt from checkpoint + WAL and no live publish has
+            # confirmed it yet (crash recovery)
+            tail += ', "restored": true'
         await self._reply_raw(
             writer, 200, prefix + tail.encode() + b"}", "application/json"
         )

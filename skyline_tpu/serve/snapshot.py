@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from skyline_tpu.resilience.faults import fault_point
+
 
 def _now_ms() -> float:
     return time.time() * 1000.0
@@ -114,6 +116,11 @@ class SnapshotStore:
         # (merge-cache hit upstream) and dedupes instead of minting a version
         self._source_key = None  # guarded-by: self._write_lock
         self.deduped = 0  # guarded-by: self._write_lock
+        # True while _latest was rebuilt from the WAL (restore_state) and no
+        # real publish has confirmed it yet — surfaced on /skyline so
+        # clients can distinguish a recovered head from a live one
+        self.restored = False  # guarded-by: self._write_lock
+        self.restores = 0  # guarded-by: self._write_lock
 
     # -- writer side (engine thread) --------------------------------------
 
@@ -145,6 +152,7 @@ class SnapshotStore:
         numbering stays dense, the delta ring sees no spurious full-replace
         delta, and subscribers don't re-fire for bytes they already have.
         ``None`` (default) never dedupes."""
+        fault_point("snapshot.publish")
         with self._write_lock:
             if (
                 source_key is not None
@@ -175,8 +183,44 @@ class SnapshotStore:
             self._latest = snap  # the atomic swap readers key off
             self._source_key = source_key
             self.published += 1
+            self.restored = False  # a live publish supersedes a recovered head
         for cb in self._subscribers:
             cb(prev, snap)
+        return snap
+
+    def restore_state(
+        self,
+        points: np.ndarray,
+        version: int,
+        watermark_id: int = -1,
+        timestamp_ms: float | None = None,
+        meta: dict | None = None,
+        advances: int = 0,
+    ) -> Snapshot:
+        """Re-seat the store from recovered state (checkpoint barrier + WAL
+        deltas) WITHOUT firing subscribers: the delta ring is re-seeded
+        separately from the same WAL records, so firing here would mint a
+        bogus everything-entered transition. Version numbering continues
+        from ``max(current, version)`` so post-restart publishes never reuse
+        a version number a pre-crash subscriber already saw."""
+        pts = np.ascontiguousarray(points, dtype=np.float32).copy()
+        pts.setflags(write=False)
+        with self._write_lock:
+            self._version = max(self._version, int(version))
+            snap = Snapshot(
+                version=self._version,
+                watermark_id=int(watermark_id),
+                timestamp_ms=_now_ms() if timestamp_ms is None else timestamp_ms,
+                points=pts,
+                digest=points_digest(pts),
+                meta=dict(meta or {}),
+            )
+            self._history.append(snap)
+            self._latest = snap
+            self._source_key = None  # recovered bytes never dedupe a publish
+            self._advances = advances
+            self.restored = True
+            self.restores += 1
         return snap
 
     # -- reader side (any thread, lock-free) ------------------------------
@@ -230,6 +274,8 @@ class SnapshotStore:
             "head_version": self._version,
             "published": self.published,
             "deduped": self.deduped,
+            "restored": self.restored,
+            "restores": self.restores,
             "version_lag": self._advances,
             "stream_watermark": self._stream_watermark,
             "history_depth": len(self._history),

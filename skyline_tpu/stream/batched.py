@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
+from skyline_tpu.resilience.faults import fault_point
 from skyline_tpu.ops.dispatch import (
     delta_dirty_cutoff,
     flush_prefilter_enabled,
@@ -569,6 +570,7 @@ class PartitionSet:
         on the device mid-stream, which is the point of the overlap policy.
         Query-time flushes keep the default (exact buckets for the global
         merge)."""
+        fault_point("flush.pre_merge")
         total = int(self._pending_rows.sum())
         if self.dims <= 2 and self.mesh is None:
             # d <= 2: the whole flush (host pendings + device window + old

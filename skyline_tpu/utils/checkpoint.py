@@ -12,16 +12,39 @@ exactly-the-same-results semantics for any subsequent stream suffix.
 from __future__ import annotations
 
 import json
+import os
+import zlib
 
 import numpy as np
 
+from skyline_tpu.resilience.faults import fault_point
 from skyline_tpu.stream.engine import EngineConfig, SkylineEngine, _QueryState
 
 _FORMAT_VERSION = 1
 
 
-def save_engine(engine: SkylineEngine, path: str) -> None:
-    """Serialize engine state to ``path`` (.npz, single file)."""
+def _content_crc(meta: dict, arrays: dict) -> int:
+    """CRC32 over the meta doc (sans the crc field itself, sort-keyed so a
+    json round trip recomputes identically) + every array's bytes in sorted
+    key order."""
+    scrubbed = {k: v for k, v in meta.items() if k != "crc32"}
+    crc = zlib.crc32(json.dumps(scrubbed, sort_keys=True).encode("utf-8"))
+    for k in sorted(arrays):
+        crc = zlib.crc32(np.ascontiguousarray(arrays[k]).tobytes(), crc)
+    return crc
+
+
+def save_engine(engine: SkylineEngine, path: str, extra_meta: dict | None = None) -> None:
+    """Serialize engine state to ``path`` (.npz, single file).
+
+    The write is atomic and torn-proof: the npz lands in ``path + ".tmp"``
+    first, is fsynced, and only then renamed over ``path`` with
+    ``os.replace`` — a crash mid-save can never corrupt the previous good
+    checkpoint. A content CRC32 (meta + arrays) rides in the meta doc so
+    ``load_engine`` detects bit rot and deliberately torn files.
+
+    ``extra_meta``: opaque caller state stored under ``meta["extra"]``
+    (the resilience layer records consumed bus offsets here)."""
     cfg = engine.config
     if engine.pset.device_ingest:
         # un-flushed rows live in the device accumulation window, which has
@@ -56,6 +79,7 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
         "pending": {},
         "inflight": [],
         "results": engine._results,
+        "extra": dict(extra_meta or {}),
     }
     for p in engine.partitions:
         arrays[f"sky_{p.partition_id}"] = p.skyline_host()
@@ -92,19 +116,38 @@ def save_engine(engine: SkylineEngine, path: str) -> None:
         )
         for pid, part in q.partials.items():
             arrays[f"qpart_{_slug(payload)}_{pid}"] = part
-    np.savez_compressed(path, __meta__=np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    meta["crc32"] = _content_crc(meta, arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __meta__=np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("checkpoint.pre_replace")
+    os.replace(tmp, path)
 
 
-def load_engine(path: str, mesh=None) -> SkylineEngine:
+def load_engine(
+    path: str, mesh=None, with_meta: bool = False, tracer=None, telemetry=None
+) -> SkylineEngine:
     """Restore an engine from a checkpoint written by ``save_engine``.
 
     ``mesh`` re-applies a device-placement choice (it is runtime state, not
-    checkpoint state — an engine saved on one topology restores onto any)."""
+    checkpoint state — an engine saved on one topology restores onto any).
+    ``with_meta=True`` returns ``(engine, meta)`` so callers can read the
+    ``extra`` doc (recovery offsets). ``tracer``/``telemetry`` thread the
+    worker's observability hubs into the restored engine. A checkpoint
+    whose content CRC disagrees raises ``ValueError`` (and a torn npz
+    raises from ``np.load``) — the checkpoint manager treats either as
+    "fall back to the previous good checkpoint"."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         if meta["version"] != _FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {meta['version']}")
+        if "crc32" in meta:  # pre-hardening checkpoints lack it; load as-is
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+            if _content_crc(meta, arrays) != meta["crc32"]:
+                raise ValueError(f"checkpoint CRC mismatch in {path}")
         # tolerate fields added/removed across versions within format 1
         import dataclasses
 
@@ -112,7 +155,12 @@ def load_engine(path: str, mesh=None) -> SkylineEngine:
         cfg = EngineConfig(
             **{k: v for k, v in meta["config"].items() if k in known}
         )
-        engine = SkylineEngine(cfg, mesh=mesh)
+        kw = {}
+        if tracer is not None:
+            kw["tracer"] = tracer
+        if telemetry is not None:
+            kw["telemetry"] = telemetry
+        engine = SkylineEngine(cfg, mesh=mesh, **kw)
         engine.records_in = meta["records_in"]
         engine.dropped = meta["dropped"]
         engine._results = meta["results"]
@@ -151,6 +199,8 @@ def load_engine(path: str, mesh=None) -> SkylineEngine:
             engine._pending_queries[int(pid_s)] = [
                 inflight_by_payload[pl] for pl in payloads if pl in inflight_by_payload
             ]
+    if with_meta:
+        return engine, meta
     return engine
 
 

@@ -68,6 +68,14 @@ class JobConfig:
     trace_out: str = ""  # write span ring as Chrome trace JSON on close
     trace_ring: int = 4096  # span ring capacity
     jax_profile_dir: str = ""  # wrap each POST /query injection in jax.profiler.trace
+    # crash safety (skyline_tpu/resilience): --checkpoint-dir enables the
+    # WAL + periodic auto-checkpointing; empty = off (the reference's
+    # lose-everything behavior)
+    checkpoint_dir: str = ""
+    checkpoint_interval_s: float = 30.0  # 0 = shutdown/manual only
+    checkpoint_retain: int = 3
+    wal_fsync: str = "batch"  # always | batch (per step) | off
+    wal_segment_bytes: int = 4_194_304
 
     def __post_init__(self):
         if self.parallelism < 1:
@@ -170,6 +178,31 @@ class JobConfig:
                 "--grid-prefilter, --flush-policy lazy/overlap, or "
                 "--initial-capacity"
             )
+        if self.checkpoint_interval_s < 0:
+            raise ValueError(
+                "checkpoint_interval_s must be >= 0, got "
+                f"{self.checkpoint_interval_s}"
+            )
+        if self.checkpoint_retain < 1:
+            raise ValueError(
+                f"checkpoint_retain must be >= 1, got {self.checkpoint_retain}"
+            )
+        if self.wal_fsync not in ("always", "batch", "off"):
+            raise ValueError(
+                f"wal_fsync must be always|batch|off, got {self.wal_fsync!r}"
+            )
+        if self.wal_segment_bytes < 4096:
+            raise ValueError(
+                f"wal_segment_bytes must be >= 4096, got {self.wal_segment_bytes}"
+            )
+        if self.window_size and self.checkpoint_dir:
+            # utils/checkpoint.py serializes the tumbling engine's state;
+            # the sliding engine's window ring is not covered — refuse
+            # rather than write checkpoints that restore the wrong shape
+            raise ValueError(
+                "sliding-window mode (--window/--slide) does not support "
+                "--checkpoint-dir"
+            )
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -202,6 +235,21 @@ class JobConfig:
             delta_ring=self.serve_delta_ring,
             history=self.serve_history,
             read_cache_entries=self.serve_read_cache,
+        )
+
+    def resilience_config(self):
+        """The ``resilience.ResilienceConfig`` this job asks for, or None
+        when crash safety is off (no --checkpoint-dir)."""
+        if not self.checkpoint_dir:
+            return None
+        from skyline_tpu.resilience import ResilienceConfig
+
+        return ResilienceConfig(
+            checkpoint_dir=self.checkpoint_dir,
+            checkpoint_interval_s=self.checkpoint_interval_s,
+            checkpoint_retain=self.checkpoint_retain,
+            wal_fsync=self.wal_fsync,
+            wal_segment_bytes=self.wal_segment_bytes,
         )
 
     def build_mesh(self):
@@ -355,6 +403,29 @@ def parse_job_args(argv=None) -> JobConfig:
                     help="opt-in: wrap each forced-query injection "
                          "(POST /query) in jax.profiler.trace writing to "
                          "this directory")
+    ap.add_argument("--checkpoint-dir",
+                    default=env_str("SKYLINE_CHECKPOINT_DIR",
+                                    defaults.checkpoint_dir),
+                    help="enable crash safety: WAL + periodic checkpoints "
+                         "under this directory (empty = off)")
+    ap.add_argument("--checkpoint-interval-s", type=float,
+                    default=env_float("SKYLINE_CHECKPOINT_INTERVAL_S",
+                                      defaults.checkpoint_interval_s),
+                    help="seconds between automatic checkpoints "
+                         "(0 = only on clean shutdown / manual)")
+    ap.add_argument("--checkpoint-retain", type=int,
+                    default=env_int("SKYLINE_CHECKPOINT_RETAIN",
+                                    defaults.checkpoint_retain),
+                    help="checkpoints kept on disk (older ones pruned)")
+    ap.add_argument("--wal-fsync", choices=("always", "batch", "off"),
+                    default=env_str("SKYLINE_WAL_FSYNC",
+                                    defaults.wal_fsync),
+                    help="WAL durability: always (per append), batch (per "
+                         "worker step), off (OS page cache only)")
+    ap.add_argument("--wal-segment-bytes", type=int,
+                    default=env_int("SKYLINE_WAL_SEGMENT_BYTES",
+                                    defaults.wal_segment_bytes),
+                    help="WAL segment rotation size")
     a = ap.parse_args(argv)
     return JobConfig(
         parallelism=a.parallelism,
@@ -391,4 +462,9 @@ def parse_job_args(argv=None) -> JobConfig:
         trace_out=a.trace_out,
         trace_ring=a.trace_ring,
         jax_profile_dir=a.jax_profile_dir,
+        checkpoint_dir=a.checkpoint_dir,
+        checkpoint_interval_s=a.checkpoint_interval_s,
+        checkpoint_retain=a.checkpoint_retain,
+        wal_fsync=a.wal_fsync,
+        wal_segment_bytes=a.wal_segment_bytes,
     )
