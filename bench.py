@@ -85,6 +85,7 @@ def analysis_stamp() -> dict:
     fail CI — perf numbers from such a tree carry an asterisk."""
     from skyline_tpu.analysis.__main__ import default_roots, repo_root, run_passes
     from skyline_tpu.analysis.registry import KNOBS
+    from skyline_tpu.utils.compile_cache import compile_cache_stats
 
     base = repo_root()
     findings, summary = run_passes(("knobs", "locks", "jaxpr"), base)
@@ -94,6 +95,9 @@ def analysis_stamp() -> dict:
     jaxpr = summary.get("jaxpr", {})
     return {
         "registry_size": len(KNOBS),
+        # persistent-cache effectiveness this process: nonzero misses on a
+        # warm BENCH_COMPILE_CACHE dir is a retrace/cache-key regression
+        "compile_cache": compile_cache_stats(),
         "lint_roots": [os.path.relpath(r, base) for r in default_roots(base)],
         "rule_counts": rule_counts,  # empty == gate clean
         "findings_total": len(findings),
@@ -219,7 +223,7 @@ def serve_leg(d: int, algo: str) -> dict:
         SnapshotStore,
     )
     from skyline_tpu.stream import EngineConfig, SkylineEngine
-    from skyline_tpu.telemetry import Histogram
+    from skyline_tpu.telemetry import Histogram, Telemetry
     from skyline_tpu.workload.generators import anti_correlated
 
     n = env_int("BENCH_SERVE_N", 65536)
@@ -227,9 +231,20 @@ def serve_leg(d: int, algo: str) -> dict:
     reads_each = env_int("BENCH_SERVE_READS", 25)
     points = "1" if env_bool("BENCH_SERVE_POINTS", False) else "0"
     rng = np.random.default_rng(1)
+    # one shared hub across engine + server: the server's /skyline handler
+    # feeds the read stage of the same freshness lineage the engine stamps
+    # (ingest/flush/merge/publish), so the stamped block below carries all
+    # five stages from one bench run (ISSUE 8 acceptance)
+    hub = Telemetry()
+    from skyline_tpu.metrics.tracing import Tracer
+
+    # non-syncing tracer: supplies the flush/merge_kernel phase total the
+    # profiler attributes its per-signature wall time against
     eng = SkylineEngine(
         EngineConfig(parallelism=2, algo=algo, dims=d, domain_max=10000.0,
-                     flush_policy="lazy")
+                     flush_policy="lazy"),
+        tracer=Tracer(),
+        telemetry=hub,
     )
     store = SnapshotStore()
     eng.attach_snapshots(store)
@@ -270,7 +285,9 @@ def serve_leg(d: int, algo: str) -> dict:
     # the same summary machinery the worker's /stats p50/p99 tiles use
     read_hist = Histogram("serve_read_ms")
     codes: list[int] = []
-    srv = SkylineServer(store, admission=AdmissionController(), port=0)
+    srv = SkylineServer(
+        store, admission=AdmissionController(), port=0, telemetry=hub
+    )
     t0 = time.perf_counter()
     hammer(srv, readers * reads_each, readers, read_hist, codes)
     wall_s = time.perf_counter() - t0
@@ -286,7 +303,12 @@ def serve_leg(d: int, algo: str) -> dict:
     srv.close()
     shed = sum(1 for c in shed_codes if c == 429)
     read_pcts = read_hist.percentiles(50, 99)
+    st = eng.stats()
     return {
+        # end-to-end lineage + per-kernel registry from the same run the
+        # reads above hit; child_main lifts these to top-level artifact keys
+        "freshness": st.get("freshness", {}),
+        "kernel_profile": st.get("kernel_profile", {}),
         "read_p50_ms": round(read_pcts["p50"], 2),
         "read_p99_ms": round(read_pcts["p99"], 2),
         "reads_ok": sum(1 for c in codes if c == 200),
@@ -414,6 +436,10 @@ def child_main(backend: str) -> None:
             serve = {"error": f"{type(e).__name__}: {e}"}
     else:
         serve = {"skipped": True}
+    # lineage + kernel registry ride the artifact as top-level blocks so
+    # scripts/bench_compare.py can gate on freshness.read_lag_p99_ms
+    freshness = serve.pop("freshness", {"skipped": True})
+    kernel_profile = serve.pop("kernel_profile", {"skipped": True})
     try:
         merge_cache, merge_tree, flush_cascade = merge_cache_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
@@ -458,6 +484,8 @@ def child_main(backend: str) -> None:
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,
+                "freshness": freshness,
+                "kernel_profile": kernel_profile,
                 "analysis": analysis,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }

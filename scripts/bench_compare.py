@@ -83,6 +83,12 @@ METRICS = (
     # noise-dominated (the merge kernels cost a wholly different fraction
     # of CPU wall), so a share swing there says nothing about the tree
     ("flush/merge_kernel share", _merge_kernel_share, False, True),
+    # freshness SLI (bench.py serve_leg lineage block): read-lag p99 is the
+    # end-to-end staleness readers actually saw — ingest event-time proxy
+    # through flush/merge/publish to the /skyline response. Absent on older
+    # artifacts (pre-lineage) -> skipped
+    ("freshness.read_lag_p99_ms", ("freshness", "read_lag_p99_ms"),
+     False, False),
 )
 
 

@@ -133,8 +133,18 @@ try:
                  # bf16_resolved registers whenever mixed precision is on
                  # and /stats above harvested the device counter)
                  "skyline_flush_prefilter_dropped_total",
-                 "skyline_flush_bf16_resolved_total"):
+                 "skyline_flush_bf16_resolved_total",
+                 # freshness lineage (ISSUE 8): per-stage lag histograms as
+                 # one labeled family, plus the span-ring drop counter and
+                 # compile-cache effectiveness (always exported, zeros incl.)
+                 "skyline_freshness_lag_ms_bucket",
+                 "skyline_telemetry_spans_dropped_total",
+                 "skyline_compile_cache_hits_total",
+                 "skyline_compile_cache_misses_total"):
         assert want in body, f"{want} missing from exposition"
+    for stage in ("ingest", "flush", "merge", "publish", "read"):
+        assert f'stage="{stage}"' in body, \
+            f"freshness stage {stage!r} missing from exposition"
     with urllib.request.urlopen(f"{serve_base}/metrics", timeout=5) as r:
         serve_body = r.read().decode()
     assert "skyline_serve_read_cache_hits_total" in serve_body, \
@@ -147,6 +157,46 @@ try:
     assert "p99" in lat["query_latency_ms"], lat
     print(f"[obs-smoke] /stats latency tiles ok: "
           f"{[k for k, v in lat.items() if v['count'] > 0]}")
+
+    # per-kernel profile: the answered queries above dispatched real merge
+    # kernels, so the registry must be non-empty on BOTH surfaces
+    for base in (stats_base, serve_base):
+        with urllib.request.urlopen(f"{base}/profile", timeout=5) as r:
+            prof = json.load(r)
+        assert prof["signatures"] >= 1 and prof["kernels"], prof
+        assert prof["dispatches"] >= prof["signatures"], prof
+    variants = {k["variant"] for k in prof["kernels"]}
+    print(f"[obs-smoke] /profile ok: {prof['signatures']} signature(s), "
+          f"{prof['dispatches']} dispatch(es), variants={sorted(variants)}")
+
+    # SLO burn-rate table: well-formed, every declared SLO evaluated over
+    # both windows, and nothing breaching on this tiny healthy run
+    with urllib.request.urlopen(f"{stats_base}/slo", timeout=5) as r:
+        slo = json.load(r)
+    assert slo["ok"] is True, slo
+    assert set(slo["slos"]) == {"read_p99", "freshness_p99",
+                                "shed_fraction", "restart_rate"}, slo
+    for name, s in slo["slos"].items():
+        assert {"fast", "slow"} <= set(s["windows"]), (name, s)
+        assert s["breach"] is False, (name, s)
+    print(f"[obs-smoke] /slo ok: {len(slo['slos'])} SLOs, no breach")
+
+    # flight recorder: flushes + merges above left dispatch decisions in
+    # the ring
+    with urllib.request.urlopen(f"{stats_base}/debug/flight", timeout=5) as r:
+        flight = json.load(r)
+    kinds = {e["kind"] for e in flight["entries"]}
+    assert "merge.launch" in kinds, sorted(kinds)
+    print(f"[obs-smoke] /debug/flight ok: {flight['recorded_total']} "
+          f"decision(s), kinds={sorted(kinds)}")
+
+    # freshness lineage end-to-end: all five stages saw samples
+    with urllib.request.urlopen(f"{stats_base}/stats", timeout=5) as r:
+        fr = json.load(r)["freshness"]
+    counts = {s: fr["stages"][s]["count"] for s in fr["stages"]}
+    assert all(c >= 1 for c in counts.values()), counts
+    assert fr["published_wm_ms"] is not None, fr
+    print(f"[obs-smoke] freshness lineage ok: stage samples {counts}")
 
     with urllib.request.urlopen(f"{stats_base}/trace", timeout=5) as r:
         doc = json.load(r)

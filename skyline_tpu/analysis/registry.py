@@ -270,6 +270,39 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_KAFKA_BACKOFF_S", "float", 0.05,
        "base kafkalite reconnect backoff (doubles per attempt)", "bridge",
        runbook="§2i"),
+    # -- observability (skyline_tpu/telemetry) -----------------------------
+    _k("SKYLINE_FRESHNESS", "bool", True,
+       "event-time freshness lineage: per-stage lag histograms "
+       "(ingest/flush/merge/publish/read) and staleness_ms on /skyline",
+       "telemetry", runbook="§2j"),
+    _k("SKYLINE_KERNEL_PROFILE", "bool", True,
+       "per-dispatch-signature kernel profiler behind GET /profile",
+       "telemetry", runbook="§2j"),
+    _k("SKYLINE_PROFILE_COST", "bool", False,
+       "capture XLA cost_analysis() FLOPs/bytes once per signature via an "
+       "AOT lower+compile (expensive; profiling sessions only)",
+       "telemetry", runbook="§2j"),
+    _k("SKYLINE_FLIGHT_RING", "int", 256,
+       "flight-recorder ring capacity (last N engine decisions, "
+       "/debug/flight and the crash dump)", "telemetry", runbook="§2j"),
+    _k("SKYLINE_SLO_FAST_WINDOW_S", "float", 300.0,
+       "fast burn-rate window for the /slo evaluation", "telemetry/slo",
+       runbook="§2j"),
+    _k("SKYLINE_SLO_SLOW_WINDOW_S", "float", 3600.0,
+       "slow burn-rate window for the /slo evaluation", "telemetry/slo",
+       runbook="§2j"),
+    _k("SKYLINE_SLO_READ_P99_MS", "float", 50.0,
+       "SLO target: serve read p99 latency threshold", "telemetry/slo",
+       runbook="§2j"),
+    _k("SKYLINE_SLO_FRESH_P99_MS", "float", 5000.0,
+       "SLO target: read-stage freshness lag p99 threshold",
+       "telemetry/slo", runbook="§2j"),
+    _k("SKYLINE_SLO_SHED_FRACTION", "float", 0.05,
+       "SLO target: max fraction of snapshot reads shed by admission",
+       "telemetry/slo", runbook="§2j"),
+    _k("SKYLINE_SLO_RESTARTS_PER_HOUR", "float", 6.0,
+       "SLO target: supervised-restart rate ceiling", "telemetry/slo",
+       runbook="§2j"),
     # -- bench harness (bench.py) ------------------------------------------
     _k("BENCH_N", "int", None,
        "window rows (default 1M on TPU, BENCH_CPU_N on the fallback)",

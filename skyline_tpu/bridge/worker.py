@@ -468,6 +468,7 @@ class SkylineWorker:
         )
         version = int(base["version"]) if base is not None else 0
         watermark = int(base.get("watermark_id", -1)) if base is not None else -1
+        event_wm = base.get("event_wm_ms") if base is not None else None
         ring_deltas = []
         for rec in delta_recs:
             entered = rows_from_b64(rec["entered"], int(rec["d"]))
@@ -484,7 +485,17 @@ class SkylineWorker:
                 )
             version = int(rec["to"])
             watermark = int(rec.get("wm", watermark))
-        self._snap_store.restore_state(points, version, watermark_id=watermark)
+            event_wm = rec.get("ewm", event_wm)
+        self._snap_store.restore_state(
+            points, version, watermark_id=watermark, event_wm_ms=event_wm
+        )
+        if event_wm is not None:
+            # the engine's tracker resumes from the recovered watermark, so
+            # a restored run's published watermarks match the uninterrupted
+            # run's (monotone-max; never regresses past replayed batches)
+            fr = getattr(self.engine, "freshness", None)
+            if fr is not None:
+                fr.restore(event_wm)
         if self._serve_ring is not None:
             self._serve_ring.seed(ring_deltas, version)
         print(
@@ -509,17 +520,18 @@ class SkylineWorker:
             else np.empty((0, snap.points.shape[1]), dtype=np.float32),
             snap.points,
         )
-        self._wal.append(
-            {
-                "type": "delta",
-                "from": prev.version if prev is not None else 0,
-                "to": snap.version,
-                "wm": snap.watermark_id,
-                "d": int(snap.points.shape[1]),
-                "entered": rows_to_b64(entered),
-                "left": rows_to_b64(left),
-            }
-        )
+        rec = {
+            "type": "delta",
+            "from": prev.version if prev is not None else 0,
+            "to": snap.version,
+            "wm": snap.watermark_id,
+            "d": int(snap.points.shape[1]),
+            "entered": rows_to_b64(entered),
+            "left": rows_to_b64(left),
+        }
+        if snap.event_wm_ms is not None:
+            rec["ewm"] = snap.event_wm_ms  # freshness lineage survives restart
+        self._wal.append(rec)
 
     def _barrier_record(self) -> dict:
         rec = {
@@ -540,6 +552,8 @@ class SkylineWorker:
                 "d": int(snap.points.shape[1]),
                 "rows": rows_to_b64(snap.points),
             }
+            if snap.event_wm_ms is not None:
+                rec["snap"]["event_wm_ms"] = snap.event_wm_ms
         return rec
 
     def checkpoint_now(self) -> str | None:
@@ -698,7 +712,13 @@ class SkylineWorker:
             self.engine.dropped += dropped
             if ids.shape[0]:
                 with self.tracer.phase("worker/ingest"):
-                    self.engine.process_records(ids, values)
+                    # wire tuples carry no producer timestamps, so the poll
+                    # wall time is the batch's event-time stamp — a
+                    # processing-time proxy the freshness lineage documents
+                    # as such (RUNBOOK §2j)
+                    self.engine.process_records(
+                        ids, values, event_ms=time.time() * 1000.0
+                    )
             if not triggers:
                 break  # no trigger pending: one poll per cycle as before
             if drains >= self.max_drain_polls:

@@ -19,6 +19,10 @@ Endpoints:
                 telemetry counters/histograms), for a standard scraper
   GET /trace    Chrome trace-event JSON of the telemetry span ring
                 (load at https://ui.perfetto.dev)
+  GET /profile  per-compiled-kernel dispatch registry (wall-time EMA,
+                compile-time canary, optional AOT cost_analysis figures)
+  GET /slo      declarative SLO table with multi-window burn rates
+  GET /debug/flight  bounded flight-recorder ring of dispatch decisions
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -163,6 +167,21 @@ class StatsServer:
                         else {"traceEvents": []}
                     )
                     handler._reply(200, doc)
+                elif handler.path == "/profile":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        handler._reply(200, outer.telemetry.profiler.doc())
+                elif handler.path == "/slo":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        handler._reply(200, outer.telemetry.slo.evaluate())
+                elif handler.path == "/debug/flight":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        handler._reply(200, outer.telemetry.flight.doc())
                 elif handler.path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"

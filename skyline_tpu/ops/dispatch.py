@@ -158,6 +158,47 @@ def query_overlap_enabled() -> bool:
     return env_bool("SKYLINE_QUERY_OVERLAP", True)
 
 
+def freshness_enabled() -> bool:
+    """``SKYLINE_FRESHNESS`` gates the event-time freshness lineage
+    (``telemetry/freshness.py``): per-batch event-time stamps carried
+    host-side through ingest → flush → merge → publish → read, the
+    ``skyline_freshness_lag_ms{stage=...}`` histograms, and the
+    ``staleness_ms`` field on ``/skyline``. Pure host bookkeeping — a few
+    float compares per micro-batch, nothing inside jit — so default ON;
+    set ``0`` to drop even that (the A/B baseline in
+    ``benchmarks/freshness.py``). Read lazily at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_FRESHNESS", True)
+
+
+def kernel_profile_enabled() -> bool:
+    """``SKYLINE_KERNEL_PROFILE`` gates the per-dispatch-signature kernel
+    profiler (``telemetry/profiler.py``): every ``flush/merge_kernel``
+    dispatch is additionally timed under its (variant, d, N-bucket,
+    backend, mp) signature and a ``kernel/<variant>`` span lands in the
+    trace ring. Two ``perf_counter_ns`` reads + one lock per dispatch,
+    host-side only; default ON, set ``0`` for the unprofiled baseline
+    (``benchmarks/freshness.py`` A/B). Read lazily at engine
+    construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_KERNEL_PROFILE", True)
+
+
+def profile_cost_enabled() -> bool:
+    """``SKYLINE_PROFILE_COST`` additionally captures XLA
+    ``cost_analysis()`` FLOPs/bytes per dispatch signature via a one-shot
+    ahead-of-time lower+compile the first time each signature is seen.
+    The AOT compile is seconds-expensive and its executable is discarded,
+    so default OFF — flip on for a profiling session when ``/profile``
+    should carry arithmetic-intensity columns. Read lazily per
+    signature."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_PROFILE_COST", False)
+
+
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
     if x.shape[1] <= 2:

@@ -93,6 +93,12 @@ class Supervisor:
                 self.restarts += 1
                 if self.telemetry is not None:
                     self.telemetry.inc("resilience.restarts")
+                    # freeze the flight-recorder ring at the crash so the
+                    # last dispatch decisions before death survive into
+                    # the next incarnation's /debug/flight
+                    fl = getattr(self.telemetry, "flight", None)
+                    if fl is not None:
+                        fl.dump(f"crash: {self.crashes[-1]}")
                 if self.restarts > self.max_restarts:
                     raise RestartBudgetExceeded(
                         f"restart budget ({self.max_restarts}) exhausted; "

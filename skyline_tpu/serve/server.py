@@ -24,6 +24,13 @@ operator observability; this one serves the skyline itself. Endpoints:
   GET  /trace     Chrome trace-event JSON of the telemetry span ring
                   (Perfetto-loadable): ingest → local → merge → publish
                   spans per query when the worker shares its hub here.
+  GET  /profile   per-dispatch-signature kernel profile (variant, d,
+                  N-bucket, backend, mp → calls / wall / EMA / retrace
+                  canary, optional cost_analysis columns).
+  GET  /slo       declarative SLO table with multi-window burn rates
+                  (read p99, freshness lag p99, shed fraction, restarts).
+  GET  /debug/flight  the flight recorder — last N engine decisions
+                  (dispatch / cascade / prune / cache), crash black box.
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -202,6 +209,9 @@ class SkylineServer:
         # /metrics and /trace here; a standalone server gets its own (the
         # read-latency histogram still works)
         self.telemetry = telemetry if telemetry is not None else Telemetry()
+        # the SLO engine samples shed/served counts from this plane's
+        # admission controller (they live on it, not the hub)
+        self.telemetry.slo.attach_admission(self.admission)
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._startup_error: BaseException | None = None
@@ -312,6 +322,12 @@ class SkylineServer:
             )
         elif path == "/query" and method == "POST":
             await self._query(writer)
+        elif path == "/profile" and method == "GET":
+            await self._reply(writer, 200, self.telemetry.profiler.doc())
+        elif path == "/slo" and method == "GET":
+            await self._reply(writer, 200, self.telemetry.slo.evaluate())
+        elif path == "/debug/flight" and method == "GET":
+            await self._reply(writer, 200, self.telemetry.flight.doc())
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -448,8 +464,15 @@ class SkylineServer:
         tail = (
             f', "age_ms": {round(rs.age_ms, 1)}'
             f', "version_lag": {rs.version_lag}'
+            f', "staleness_ms": {round(rs.staleness_ms, 1)}'
             f', "stale": {"true" if not rs.fresh else "false"}'
         )
+        # the freshness lineage's terminal stage: how old the newest event
+        # a CLIENT actually saw was at response time (event-time when the
+        # snapshot carries a watermark, publish-age otherwise)
+        self.telemetry.histogram(
+            "freshness_lag_ms", labels=(("stage", "read"),)
+        ).observe(rs.staleness_ms)
         if refresh_triggered:
             tail += ', "refresh_triggered": true'
         if self.store.restored:

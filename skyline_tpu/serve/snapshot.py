@@ -50,6 +50,10 @@ class Snapshot:
     points: np.ndarray  # (k, d) float32, read-only
     digest: str  # sha1 of the points buffer, stamped at publish
     meta: dict = field(default_factory=dict)
+    # newest producer EVENT time (ms epoch) reflected in these points —
+    # the freshness lineage's published watermark (None when the engine
+    # runs without the tracker); rides the WAL so restores keep lineage
+    event_wm_ms: float | None = None
 
     @property
     def size(self) -> int:
@@ -63,6 +67,8 @@ class Snapshot:
             "skyline_size": self.size,
             "digest": self.digest,
         }
+        if self.event_wm_ms is not None:
+            doc["event_wm_ms"] = self.event_wm_ms
         doc.update(self.meta)
         if include_points:
             doc["points"] = self.points.tolist()
@@ -78,13 +84,18 @@ def points_digest(points: np.ndarray) -> str:
 class ReadStatus:
     """Outcome of a bounded read: the snapshot plus why/whether it's fresh."""
 
-    __slots__ = ("snapshot", "fresh", "age_ms", "version_lag")
+    __slots__ = ("snapshot", "fresh", "age_ms", "version_lag", "staleness_ms")
 
-    def __init__(self, snapshot, fresh, age_ms, version_lag):
+    def __init__(self, snapshot, fresh, age_ms, version_lag, staleness_ms=None):
         self.snapshot = snapshot
         self.fresh = fresh
         self.age_ms = age_ms
         self.version_lag = version_lag
+        # event-time staleness: now - snapshot.event_wm_ms when the engine
+        # publishes watermarks, else the processing-time age (the honest
+        # fallback — without event stamps the publish instant is the newest
+        # event knowledge we have)
+        self.staleness_ms = age_ms if staleness_ms is None else staleness_ms
 
 
 class SnapshotStore:
@@ -108,6 +119,7 @@ class SnapshotStore:
         # (they feed monotonic lag gauges, not correctness)
         self._advances = 0  # ingest advances since the last publish
         self._stream_watermark = -1
+        self._event_watermark_ms: float | None = None  # same discipline
         self._write_lock = threading.Lock()
         self._subscribers: list = []  # publish callbacks (delta ring, tests)
         self.published = 0  # guarded-by: self._write_lock
@@ -129,12 +141,24 @@ class SnapshotStore:
         synchronously on the publishing thread after each swap."""
         self._subscribers.append(callback)
 
-    def note_ingest(self, watermark_id: int | None = None, batches: int = 1) -> None:
+    def note_ingest(
+        self,
+        watermark_id: int | None = None,
+        batches: int = 1,
+        event_ms: float | None = None,
+    ) -> None:
         """The engine absorbed new data: the latest snapshot is now one
-        (more) version-lag unit behind. Cheap — two int updates."""
+        (more) version-lag unit behind. ``event_ms`` (optional) advances the
+        unpublished event-time high watermark the same torn-read-tolerant
+        way. Cheap — a few scalar updates."""
         self._advances += batches
         if watermark_id is not None and watermark_id > self._stream_watermark:
             self._stream_watermark = watermark_id
+        if event_ms is not None and (
+            self._event_watermark_ms is None
+            or event_ms > self._event_watermark_ms
+        ):
+            self._event_watermark_ms = event_ms
 
     def publish(
         self,
@@ -142,6 +166,7 @@ class SnapshotStore:
         watermark_id: int | None = None,
         now_ms: float | None = None,
         source_key=None,
+        event_wm_ms: float | None = None,
         **meta,
     ) -> Snapshot:
         """Freeze ``points`` as the next version and swap it in.
@@ -169,6 +194,8 @@ class SnapshotStore:
             self._version += 1
             if watermark_id is None:
                 watermark_id = self._stream_watermark
+            if event_wm_ms is None:
+                event_wm_ms = self._event_watermark_ms
             snap = Snapshot(
                 version=self._version,
                 watermark_id=int(watermark_id),
@@ -176,6 +203,7 @@ class SnapshotStore:
                 points=pts,
                 digest=points_digest(pts),
                 meta=dict(meta),
+                event_wm_ms=event_wm_ms,
             )
             prev = self._latest
             self._history.append(snap)
@@ -196,6 +224,7 @@ class SnapshotStore:
         timestamp_ms: float | None = None,
         meta: dict | None = None,
         advances: int = 0,
+        event_wm_ms: float | None = None,
     ) -> Snapshot:
         """Re-seat the store from recovered state (checkpoint barrier + WAL
         deltas) WITHOUT firing subscribers: the delta ring is re-seeded
@@ -214,11 +243,17 @@ class SnapshotStore:
                 points=pts,
                 digest=points_digest(pts),
                 meta=dict(meta or {}),
+                event_wm_ms=event_wm_ms,
             )
             self._history.append(snap)
             self._latest = snap
             self._source_key = None  # recovered bytes never dedupe a publish
             self._advances = advances
+            if event_wm_ms is not None and (
+                self._event_watermark_ms is None
+                or event_wm_ms > self._event_watermark_ms
+            ):
+                self._event_watermark_ms = event_wm_ms
             self.restored = True
             self.restores += 1
         return snap
@@ -266,7 +301,12 @@ class SnapshotStore:
             fresh = False
         if max_version_lag is not None and lag > max_version_lag:
             fresh = False
-        return ReadStatus(snap, fresh, age_ms, lag)
+        staleness = (
+            max(0.0, now - snap.event_wm_ms)
+            if snap.event_wm_ms is not None
+            else None
+        )
+        return ReadStatus(snap, fresh, age_ms, lag, staleness_ms=staleness)
 
     def stats(self) -> dict:
         snap = self._latest
@@ -278,6 +318,10 @@ class SnapshotStore:
             "restores": self.restores,
             "version_lag": self._advances,
             "stream_watermark": self._stream_watermark,
+            "event_watermark_ms": self._event_watermark_ms,
+            "published_event_wm_ms": (
+                snap.event_wm_ms if snap is not None else None
+            ),
             "history_depth": len(self._history),
             "latest_size": snap.size if snap is not None else 0,
             "latest_age_ms": (

@@ -28,6 +28,7 @@ Semantic deltas vs per-partition flushing, both documented here on purpose:
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 
 import jax.numpy as jnp
 import numpy as np
@@ -43,6 +44,7 @@ from skyline_tpu.ops.dispatch import (
     merge_tree_enabled,
     mixed_precision_enabled,
     on_tpu,
+    profile_cost_enabled,
 )
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
@@ -285,6 +287,11 @@ class PartitionSet:
         # {key, epoch, counts, surv, g, pts_dev, pts_host}
         self._gm_cache: dict | None = None
         self._counters = counters
+        # kernel profiler + decision flight recorder (telemetry/profiler.py),
+        # attached by the engine when observability is enabled; None keeps
+        # every dispatch site on the bare tracer-phase path
+        self._profiler = None
+        self._flight = None
         self.merge_cache_hits = 0
         self.merge_cache_misses = 0
         self.merge_delta_merges = 0
@@ -352,6 +359,54 @@ class PartitionSet:
     def _inc(self, name: str, n: int = 1) -> None:
         if self._counters is not None:
             self._counters.inc(name, n)
+
+    # -- observability hooks ------------------------------------------------
+
+    def attach_observability(self, profiler=None, flight=None) -> None:
+        """Attach a ``telemetry.profiler.KernelProfiler`` and/or
+        ``FlightRecorder``. The profiler sub-attributes every
+        ``flush/merge_kernel`` tracer phase to its dispatch signature
+        (variant, d, N-bucket, backend, mp — see stream/window.py
+        ``KERNEL_VARIANTS``); the flight recorder keeps the last N
+        dispatch/cascade/prune/cache decisions. Both are host-side wrappers
+        around already-timed regions — skyline bytes are unchanged."""
+        self._profiler = profiler
+        self._flight = flight
+
+    def _kernel(self, variant: str, n: int, mp: bool = False, cost_thunk=None):
+        """Profiling context for one merge-kernel dispatch (nullcontext
+        when no profiler is attached)."""
+        if self._profiler is None:
+            return nullcontext()
+        return self._profiler.record(
+            variant, self.dims, n, mp=mp, cost_thunk=cost_thunk
+        )
+
+    def _merge_cost_thunk(self, batch_dev, bvalid_dev, active, out_active, mp):
+        """AOT ``cost_analysis()`` thunk for the incremental merge step's
+        current dispatch signature (``SKYLINE_PROFILE_COST``). Shapes are
+        captured eagerly — the live buffers are donated by the dispatch —
+        and the lower+compile runs only once per signature, inside the
+        profiler's first-call path."""
+        import jax
+
+        shapes = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype)
+            for a in (self.sky, self.sky_valid, batch_dev, bvalid_dev)
+        )
+
+        def thunk():
+            return (
+                merge_step_active.lower(*shapes, active, out_active, mp)
+                .compile()
+                .cost_analysis()
+            )
+
+        return thunk
+
+    def _fnote(self, kind: str, **fields) -> None:
+        if self._flight is not None:
+            self._flight.note(kind, **fields)
 
     # -- ingest -----------------------------------------------------------
 
@@ -599,6 +654,10 @@ class PartitionSet:
         # pending rows (heavy skew) take extra rounds
         B = _next_pow2(min(max_rows, max(self.buffer_size, _MIN_CAP)))
         n_rounds = -(-max_rows // B)
+        self._fnote(
+            "flush.dispatch", policy="incremental", rows=total,
+            rounds=n_rounds, block=B,
+        )
         # staged pipeline: round r+1..r+depth are assembled and device_put
         # AFTER round r's merge kernel is dispatched (async), so host-side
         # assembly and the upload overlap the in-flight kernel — and a
@@ -629,7 +688,24 @@ class PartitionSet:
                 self._count_ub = np.asarray(self._count_dev, dtype=np.int64)
                 grow = _grow_bucket()
             out_cap = max(self._cap, grow)
-            with self.tracer.phase("flush/merge_kernel"):
+            variant = (
+                "meshed_merge_step" if self.mesh is not None else "merge_step"
+            )
+            active = cost_thunk = None
+            if self.mesh is None:
+                # active-prefix merge: dominance passes + compact run
+                # over the live-count bucket, not the storage capacity.
+                active = min(
+                    self._cap,
+                    _active_bucket(max(int(self._count_ub.max()), 1)),
+                )
+                if self._profiler is not None and profile_cost_enabled():
+                    cost_thunk = self._merge_cost_thunk(
+                        batch_dev, bvalid_dev, active, grow, mp
+                    )
+            with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                variant, out_cap, mp, cost_thunk=cost_thunk
+            ):
                 if self.mesh is not None:
                     # explicit SPMD: pallas_call has no GSPMD partitioning
                     # rule, so the meshed flush must shard_map over the
@@ -643,15 +719,9 @@ class PartitionSet:
                         self.sky, self.sky_valid, batch_dev, bvalid_dev
                     )
                 else:
-                    # active-prefix merge: dominance passes + compact run
-                    # over the live-count bucket, not the storage capacity.
                     # out_active is the SAME bucket out_cap grew from, so
                     # merge_step_active's max(cap, out_active) == out_cap
                     # structurally.
-                    active = min(
-                        self._cap,
-                        _active_bucket(max(int(self._count_ub.max()), 1)),
-                    )
                     self.sky, self.sky_valid, self._count_dev, res = (
                         merge_step_active(
                             self.sky,
@@ -732,7 +802,12 @@ class PartitionSet:
             active = min(
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
-            with self.tracer.phase("flush/merge_kernel"):
+            variant = (
+                "meshed_sfs_round" if self.mesh is not None else "sfs_vmapped"
+            )
+            with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                variant, active, mp
+            ):
                 if self.mesh is not None:
                     rnd_fn = meshed_sfs_round(
                         self.mesh, self.mesh.axis_names[0], on_tpu(), active,
@@ -869,7 +944,9 @@ class PartitionSet:
                     with self.tracer.phase("flush/device_put"):
                         block_dev = jnp.asarray(block)
                         bvalid_dev = jnp.asarray(bvalid)
-                    with self.tracer.phase("flush/merge_kernel"):
+                    with self.tracer.phase("flush/merge_kernel"), (
+                        self._kernel("sfs_sequential", active, mp)
+                    ):
                         sky_p, cnt_p, res = sfs_round_single(
                             sky_p, cnt_p, block_dev, bvalid_dev, active, mp
                         )
@@ -947,7 +1024,12 @@ class PartitionSet:
                             sky_p = self._pad_sky_rows(sky_p, cap_p)
                     w = min(B, hi - off)
                     active = min(cap_p, _active_bucket(max(ub_p, 1)))
-                    with self.tracer.phase("flush/merge_kernel"):
+                    variant = (
+                        "sfs_rank" if rank is not None else "sfs_sequential"
+                    )
+                    with self.tracer.phase("flush/merge_kernel"), (
+                        self._kernel(variant, active, mp)
+                    ):
                         if rank is not None:
                             sky_p, cnt_p = dw.sfs_round_at_rank(
                                 sky_p, cnt_p, ws, rank[0], rank[1],
@@ -1101,6 +1183,8 @@ class PartitionSet:
                         rows[p] = r[keep]
         self.prefilter_seen += seen
         self.prefilter_dropped += dropped
+        if seen:
+            self._fnote("flush.prefilter", seen=seen, dropped=dropped)
         # inc 0 too: the Prometheus series must register at the first
         # prefiltered flush, not the first nonzero drop (obs_smoke asserts
         # presence right after one flush+stats round trip)
@@ -1165,9 +1249,14 @@ class PartitionSet:
         # costs ~total_rows. Under routing skew (mr-angle at 8D sends ~96%
         # of rows to 2 of 8 partitions) sequential wins by ~P/2; balanced
         # streams keep the one-launch-per-round batching.
-        if self.mesh is None and (
+        sequential = self.mesh is None and (
             self.num_partitions * max_rows > 2 * total_rows
-        ):
+        )
+        self._fnote(
+            "flush.dispatch", policy=self.flush_policy, rows=total_rows,
+            max_rows=max_rows, sequential=sequential,
+        )
+        if sequential:
             counts = self._sfs_sequential(rows)
         else:
             counts = self._sfs_vmapped(rows, max_rows)
@@ -1206,7 +1295,9 @@ class PartitionSet:
             active = min(
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
-            with self.tracer.phase("flush/merge_kernel"):
+            with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                "sfs_cleanup", active
+            ):
                 if rank is not None:
                     self.sky, counts = dw.sfs_cleanup_rank(
                         self.sky,
@@ -1426,7 +1517,12 @@ class PartitionSet:
         max_rows = int(widths.max())
         total_rows = int(widths.sum())
         # same skew heuristic as the host path (see _flush_lazy)
-        if self.num_partitions * max_rows > 2 * total_rows:
+        sequential = self.num_partitions * max_rows > 2 * total_rows
+        self._fnote(
+            "flush.dispatch", policy=self.flush_policy, device_window=True,
+            rows=total_rows, max_rows=max_rows, sequential=sequential,
+        )
+        if sequential:
             counts = self._sfs_sequential_dev(ws, bounds, rank, tighten)
         else:
             counts = self._sfs_vmapped_dev(ws, bounds, max_rows, rank)
@@ -1470,7 +1566,10 @@ class PartitionSet:
             active = min(
                 self._cap, _active_bucket(max(int(self._count_ub.max()), 1))
             )
-            with self.tracer.phase("flush/merge_kernel"):
+            variant = "sfs_rank" if rank is not None else "sfs_vmapped"
+            with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                variant, active, mp
+            ):
                 offs_d = jnp.asarray(offs.astype(np.int32))
                 w_d = jnp.asarray(w.astype(np.int32))
                 if rank is not None:
@@ -1559,6 +1658,7 @@ class PartitionSet:
             # harvest can't be skewed by a later cache replacement
             self.merge_cache_hits += 1
             self._inc("merge.cache_hit")
+            self._fnote("merge.cache_hit", key=h.key)
             self._counts_cache = cache["counts"].copy()
             self._count_ub = cache["counts"].copy()
             h.cached = True
@@ -1583,6 +1683,13 @@ class PartitionSet:
             self.last_dirty_fraction = 1.0  # cold miss == everything dirty
         use_tree = (
             self.mesh is None and self.dims > 2 and merge_tree_enabled()
+        )
+        self._fnote(
+            "merge.launch",
+            path=("tree_delta" if dirty is not None and use_tree
+                  else "delta" if dirty is not None
+                  else "tree" if use_tree else "flat"),
+            dirty_fraction=self.last_dirty_fraction,
         )
         stats = None
         if dirty is not None:
@@ -1885,6 +1992,9 @@ class PartitionSet:
         # first tree merge, not the first nonzero prune
         self._inc("merge.tree_levels", levels)
         self._inc("merge.partitions_pruned", npruned)
+        self._fnote(
+            "merge.tree", levels=levels, pruned=npruned, considered=considered,
+        )
         self.last_tree_info = {
             "levels": levels,
             "partitions_pruned": npruned,

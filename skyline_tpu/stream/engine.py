@@ -224,6 +224,31 @@ class SkylineEngine:
         # global skyline publishes as an immutable versioned snapshot and
         # every ingest micro-batch advances its staleness counter
         self.snapshots = None
+        # observability plane (ISSUE 8): freshness lineage + the per-kernel
+        # dispatch profiler / flight recorder. All host-side — nothing here
+        # touches the jitted byte-identity path. Without a hub the engine
+        # still owns private instances so bench legs get the stats blocks.
+        from skyline_tpu.ops.dispatch import (
+            freshness_enabled,
+            kernel_profile_enabled,
+        )
+        from skyline_tpu.telemetry import FreshnessTracker, KernelProfiler
+
+        self.freshness = (
+            FreshnessTracker(telemetry) if freshness_enabled() else None
+        )
+        if kernel_profile_enabled():
+            self.profiler = (
+                telemetry.profiler
+                if telemetry is not None
+                else KernelProfiler()
+            )
+        else:
+            self.profiler = None
+        self.pset.attach_observability(
+            profiler=self.profiler,
+            flight=telemetry.flight if telemetry is not None else None,
+        )
 
     def attach_snapshots(self, store) -> None:
         """Publish completed global skylines to ``store`` (a
@@ -235,18 +260,26 @@ class SkylineEngine:
     # -- data plane -------------------------------------------------------
 
     def process_records(
-        self, ids: np.ndarray, values: np.ndarray, now_ms: float | None = None
+        self,
+        ids: np.ndarray,
+        values: np.ndarray,
+        now_ms: float | None = None,
+        event_ms=None,
     ) -> None:
         """Route a micro-batch of records to partitions and advance barriers.
 
         ids: (N,) int64 global record ids; values: (N, d) float32.
+        ``event_ms`` (optional): producer event-time of this batch for the
+        freshness lineage — a scalar or a (min, max) pair in epoch ms. The
+        wire format carries no timestamps, so callers typically stamp the
+        poll wall time (a processing-time proxy; RUNBOOK §2j).
         """
         tel = self.telemetry
         if tel is None:
-            return self._process_records(ids, values, now_ms)
+            return self._process_records(ids, values, now_ms, event_ms)
         t0 = time.perf_counter_ns()
         try:
-            return self._process_records(ids, values, now_ms)
+            return self._process_records(ids, values, now_ms, event_ms)
         finally:
             end = time.perf_counter_ns()
             tel.histogram("ingest_batch_ms").observe((end - t0) / 1e6)
@@ -255,7 +288,11 @@ class SkylineEngine:
             )
 
     def _process_records(
-        self, ids: np.ndarray, values: np.ndarray, now_ms: float | None = None
+        self,
+        ids: np.ndarray,
+        values: np.ndarray,
+        now_ms: float | None = None,
+        event_ms=None,
     ) -> None:
         if values.shape[0] == 0:
             return
@@ -263,9 +300,21 @@ class SkylineEngine:
             now_ms = time.time() * 1000.0
         cfg = self.config
         self.records_in += values.shape[0]
+        ev_hi = None
+        if self.freshness is not None:
+            # stamp the batch's event-time window; absent stamps fall back
+            # to the wall clock (NOT the caller's now_ms — tests inject
+            # synthetic clocks that would poison the lag histograms)
+            if event_ms is None:
+                ev_lo = ev_hi = time.time() * 1000.0
+            elif isinstance(event_ms, (tuple, list)):
+                ev_lo, ev_hi = float(event_ms[0]), float(event_ms[1])
+            else:
+                ev_lo = ev_hi = float(event_ms)
+            self.freshness.on_ingest(ev_lo, ev_hi)
         if self.snapshots is not None:
             # the latest snapshot is now one ingest advance behind
-            self.snapshots.note_ingest(int(ids.max()))
+            self.snapshots.note_ingest(int(ids.max()), event_ms=ev_hi)
         if self.pset.device_ingest:
             # routing + barrier stats on device; host bookkeeping syncs only
             # when a pending query needs its barrier re-evaluated
@@ -276,6 +325,7 @@ class SkylineEngine:
                 for p in range(cfg.num_partitions):
                     now_ms = self._recheck_pending(p, now_ms)
             self.pset.maybe_flush()
+            self._note_flush()
             self._harvest_inflight(block=False)
             return
         with self.tracer.phase("partition_ids"):
@@ -329,6 +379,7 @@ class SkylineEngine:
                 now_ms = self._recheck_pending(p, now_ms)
         # one batched launch merges every partition's pending rows at once
         self.pset.maybe_flush()
+        self._note_flush()
         if doomed_pids is not None:
             # partitions whose barrier advanced only via dropped rows still
             # need their pending queries rechecked (after the kept rows of
@@ -338,6 +389,14 @@ class SkylineEngine:
         # an overlapped merge whose bytes already landed costs ~nothing to
         # harvest here; one that hasn't stays in flight (never block ingest)
         self._harvest_inflight(block=False)
+
+    def _note_flush(self) -> None:
+        """Advance the freshness flush stage once NO ingested rows remain
+        host-pending — lazy/overlap policies may leave rows buffered past a
+        ``maybe_flush``, and those batches must keep aging in the ingest
+        stage until a flush actually absorbs them."""
+        if self.freshness is not None and self.pset.pending_rows_total == 0:
+            self.freshness.on_flush()
 
     # -- control plane ----------------------------------------------------
 
@@ -409,6 +468,7 @@ class SkylineEngine:
         part = self.partitions[p]
         t0 = time.perf_counter_ns()
         local = part.snapshot()
+        self._note_flush()
         t1 = time.perf_counter_ns()
         if self.telemetry is not None:
             self.telemetry.spans.record(
@@ -462,6 +522,8 @@ class SkylineEngine:
             origins[keep], minlength=self.config.num_partitions
         )
 
+        if self.freshness is not None:
+            self.freshness.on_merge()
         merge_end_ns = time.perf_counter_ns()
         merge_ms = (merge_end_ns - merge_t0) / 1e6
         if self.telemetry is not None:
@@ -514,6 +576,10 @@ class SkylineEngine:
         meta = {"query_id": q.qid, "source_key": source_key}
         if q.trace_id is not None:
             meta["trace_id"] = q.trace_id
+        if self.freshness is not None:
+            # the merged window's newest event time becomes the snapshot's
+            # published watermark (monotone; None until any event stamped)
+            meta["event_wm_ms"] = self.freshness.on_publish()
         if self.telemetry is None:
             self.snapshots.publish(points, **meta)
             return
@@ -584,6 +650,7 @@ class SkylineEngine:
         tel = self.telemetry
         t0 = time.perf_counter_ns()
         self.pset.flush_all()
+        self._note_flush()
         flush_end_ns = time.perf_counter_ns()
         flush_wall_ms = (flush_end_ns - t0) / 1e6
         if tel is not None:
@@ -613,6 +680,8 @@ class SkylineEngine:
         counts, surv, g, pts = self.pset.global_merge_stats(
             emit_points=want_points
         )
+        if self.freshness is not None:
+            self.freshness.on_merge()
         merge_end_ns = time.perf_counter_ns()
         merge_ms = (merge_end_ns - t1) / 1e6
         if tel is not None:
@@ -639,6 +708,8 @@ class SkylineEngine:
         self._inflight_merge = None
         h0 = time.perf_counter_ns()
         counts, surv, g, pts = self.pset.global_merge_harvest(handle)
+        if self.freshness is not None:
+            self.freshness.on_merge()
         h1 = time.perf_counter_ns()
         # the query's merge cost = launch dispatch + harvest sync; the
         # in-flight span in between ran under ingest, so charging it here
@@ -776,6 +847,13 @@ class SkylineEngine:
             },
             "flush_cascade": self.pset.flush_cascade_stats(),
         }
+        if self.freshness is not None:
+            out["freshness"] = self.freshness.stats()
+        if self.profiler is not None:
+            phase = self.tracer.report().get("flush/merge_kernel")
+            out["kernel_profile"] = self.profiler.doc(
+                phase_total_ms=phase["total_ms"] if phase else None
+            )
         if include_skyline_counts:
             out["partitions"]["skyline_counts"] = (
                 self.pset.sky_counts().tolist()

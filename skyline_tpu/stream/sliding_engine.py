@@ -196,9 +196,13 @@ class SlidingEngine:
 
     # -- data plane -------------------------------------------------------
 
-    def process_records(self, ids, values, now_ms: float | None = None) -> None:
+    def process_records(
+        self, ids, values, now_ms: float | None = None, event_ms=None
+    ) -> None:
         """Split the batch at global slide boundaries, route each segment,
-        close slides as they fill."""
+        close slides as they fill. ``event_ms`` is accepted for call-site
+        parity with ``SkylineEngine`` and ignored — the freshness lineage
+        covers the tumbling engine only (RUNBOOK §2j)."""
         tel = self.telemetry
         if tel is None:
             return self._process_records(ids, values, now_ms)

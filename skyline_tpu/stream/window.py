@@ -42,6 +42,21 @@ from skyline_tpu.utils.buckets import next_pow2
 # FlinkSkyline.java:232); we default to the nearest power of two.
 DEFAULT_BUFFER_SIZE = 4096
 
+# Dispatch-signature variant names for the kernel profiler
+# (telemetry/profiler.py): every ``flush/merge_kernel`` tracer site in
+# stream/batched.py attributes its wall time to one of these. The mapping
+# is documentation + a closed vocabulary for /profile consumers; the
+# profiler itself accepts any string.
+KERNEL_VARIANTS = {
+    "merge_step": "batched merge of one micro-batch into all partitions",
+    "meshed_merge_step": "shard_map merge across a device mesh",
+    "sfs_vmapped": "vmapped sort-filter-skyline flush round",
+    "meshed_sfs_round": "shard_map SFS flush round",
+    "sfs_sequential": "single-partition SFS flush round",
+    "sfs_rank": "device-resident SFS round (per-rank / vmapped dw paths)",
+    "sfs_cleanup": "lazy-flush cleanup pass",
+}
+
 # Minimum buffer capacity. Power-of-two buckets >= this always divide the
 # Pallas tile sizes after the kernels' min(tile, n) clamp
 # (ops/pallas_dominance.py), which is what keeps sub-COL_TILE buffers legal.

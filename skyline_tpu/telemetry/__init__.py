@@ -28,8 +28,11 @@ from skyline_tpu.telemetry.histogram import DEFAULT_EDGES, Histogram
 from skyline_tpu.telemetry.prometheus import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
 )
+from skyline_tpu.telemetry.freshness import FreshnessTracker
+from skyline_tpu.telemetry.profiler import FlightRecorder, KernelProfiler
 from skyline_tpu.telemetry.prometheus import flatten_gauges
 from skyline_tpu.telemetry.prometheus import render as render_prometheus
+from skyline_tpu.telemetry.slo import SloEngine
 from skyline_tpu.telemetry.spans import SpanRecorder, mint_trace_id
 
 
@@ -42,10 +45,19 @@ class Telemetry:
     """
 
     def __init__(self, span_capacity: int = 4096):
+        from skyline_tpu.analysis.registry import env_int
+
         self.counters = Counters()
         self.spans = SpanRecorder(span_capacity)
-        self._hists: dict[str, Histogram] = {}
+        self._hists: dict[tuple, Histogram] = {}
         self._lock = threading.Lock()
+        # observability companions (ISSUE 8): the per-kernel dispatch
+        # profiler, the decision flight recorder, and the SLO burn-rate
+        # engine all hang off the hub so both HTTP servers can serve
+        # /profile, /debug/flight and /slo from whatever they were handed
+        self.profiler = KernelProfiler(spans=self.spans)
+        self.flight = FlightRecorder(env_int("SKYLINE_FLIGHT_RING", 256))
+        self.slo = SloEngine(self)
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -53,14 +65,22 @@ class Telemetry:
         reach through it)."""
         self.counters.inc(name, n)
 
-    def histogram(self, name: str, unit: str = "ms") -> Histogram:
-        h = self._hists.get(name)
+    def histogram(
+        self,
+        name: str,
+        unit: str = "ms",
+        labels: tuple[tuple[str, str], ...] | None = None,
+    ) -> Histogram:
+        """Get-or-create a histogram; ``labels`` (a ``((key, value), ...)``
+        tuple) keys a distinct series inside the same Prometheus family."""
+        key = (name, tuple(labels) if labels else None)
+        h = self._hists.get(key)
         if h is None:
             with self._lock:
-                h = self._hists.get(name)
+                h = self._hists.get(key)
                 if h is None:
-                    h = Histogram(name, unit=unit)
-                    self._hists[name] = h
+                    h = Histogram(name, unit=unit, labels=labels)
+                    self._hists[key] = h
         return h
 
     def histograms(self) -> list[Histogram]:
@@ -72,8 +92,15 @@ class Telemetry:
 
     def latency_snapshot(self) -> dict[str, dict]:
         """{hist name: {count, mean, p50, p90, p99, ...}} for /stats and
-        the dashboard's percentile tiles."""
-        return {h.name: h.snapshot() for h in self.histograms()}
+        the dashboard's percentile tiles. Labeled series get a
+        ``name{k=v}`` display key so families don't collide."""
+        out = {}
+        for h in self.histograms():
+            key = h.name
+            if h.labels:
+                key += "{" + ",".join(f"{k}={v}" for k, v in h.labels) + "}"
+            out[key] = h.snapshot()
+        return out
 
     def render_prometheus(
         self,
@@ -82,6 +109,18 @@ class Telemetry:
         prefix: str = "skyline",
     ) -> str:
         counters = dict(self.counters.snapshot())
+        # span-ring overwrites are silent data loss for /trace readers;
+        # always expose the drop counter (zero included) so dashboards can
+        # alert on the first overwrite
+        counters["telemetry.spans_dropped"] = self.spans.dropped
+        # persistent-compile-cache effectiveness (utils/compile_cache.py):
+        # a rising miss count on a warm cache is a retrace regression
+        # visible without the jaxpr audit
+        from skyline_tpu.utils.compile_cache import compile_cache_stats
+
+        cc = compile_cache_stats()
+        counters["compile_cache.hits"] = cc["hits"]
+        counters["compile_cache.misses"] = cc["misses"]
         if extra_counters:
             counters.update(extra_counters)
         return render_prometheus(
@@ -95,9 +134,13 @@ class Telemetry:
 __all__ = [
     "Counters",
     "DEFAULT_EDGES",
+    "FlightRecorder",
+    "FreshnessTracker",
     "Histogram",
+    "KernelProfiler",
     "NULL_TRACER",
     "PROMETHEUS_CONTENT_TYPE",
+    "SloEngine",
     "SpanRecorder",
     "Telemetry",
     "Tracer",

@@ -41,9 +41,14 @@ class Histogram:
         unit: str = "ms",
         edges: tuple[float, ...] | None = None,
         sample_cap: int = 1024,
+        labels: tuple[tuple[str, str], ...] | None = None,
     ):
         self.name = name
         self.unit = unit
+        # optional fixed label set (e.g. (("stage", "ingest"),)): histograms
+        # sharing a name but differing in labels render as one Prometheus
+        # family with one series per label set
+        self.labels = tuple(labels) if labels else None
         self._edges = tuple(edges) if edges is not None else DEFAULT_EDGES
         if any(b <= a for a, b in zip(self._edges, self._edges[1:])):
             raise ValueError("histogram edges must be strictly increasing")

@@ -32,6 +32,17 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def _labels_text(labels) -> str:
+    """Render a ((key, value), ...) label tuple as ``k1="v1",k2="v2"``."""
+    if not labels:
+        return ""
+    parts = []
+    for k, v in labels:
+        val = str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        parts.append(f'{sanitize(k)}="{val}"')
+    return ",".join(parts)
+
+
 def flatten_gauges(doc: dict, prefix: str = "") -> dict[str, float]:
     """Flatten a nested stats dict into gauge samples: numbers kept (bools
     as 0/1), strings/lists/None dropped, sub-dicts joined with ``_``."""
@@ -64,11 +75,20 @@ def render(
         m = f"{prefix}_{sanitize(name)}"
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {_fmt(gauges[name])}")
+    # group histograms into families: one # TYPE line per metric name, then
+    # every label set's series. Unlabeled histograms are one-member families,
+    # so their rendering is unchanged.
+    families: dict[str, list] = {}
     for h in histograms or ():
-        m = f"{prefix}_{sanitize(h.name)}"
+        families.setdefault(f"{prefix}_{sanitize(h.name)}", []).append(h)
+    for m, members in families.items():
         lines.append(f"# TYPE {m} histogram")
-        for le, cum in h.bucket_counts():
-            lines.append(f'{m}_bucket{{le="{_fmt(le)}"}} {cum}')
-        lines.append(f"{m}_sum {repr(float(h.sum))}")
-        lines.append(f"{m}_count {h.count}")
+        for h in members:
+            base = _labels_text(getattr(h, "labels", None))
+            joiner = "," if base else ""
+            for le, cum in h.bucket_counts():
+                lines.append(f'{m}_bucket{{{base}{joiner}le="{_fmt(le)}"}} {cum}')
+            brace = f"{{{base}}}" if base else ""
+            lines.append(f"{m}_sum{brace} {repr(float(h.sum))}")
+            lines.append(f"{m}_count{brace} {h.count}")
     return "\n".join(lines) + "\n"

@@ -55,6 +55,7 @@ class SpanRecorder:
         self._anchor_ns = time.perf_counter_ns()
         self.anchor_epoch_ms = time.time() * 1000.0
         self.recorded = 0  # total ever recorded  # guarded-by: self._lock
+        self.dropped = 0  # overwritten by ring eviction  # guarded-by: self._lock
 
     def record(
         self,
@@ -77,6 +78,8 @@ class SpanRecorder:
         if args:
             span["args"] = args
         with self._lock:
+            if len(self._ring) == self.capacity:
+                self.dropped += 1  # appending evicts the oldest span
             self._ring.append(span)
             self.recorded += 1
 
@@ -130,6 +133,10 @@ class SpanRecorder:
             "otherData": {
                 "anchor_epoch_ms": self.anchor_epoch_ms,
                 "spans_recorded_total": self.recorded,
+                "spans_dropped_total": self.dropped,
+                # dropped > 0 means the ring overwrote older spans: the trace
+                # is a partial window over the most recent `capacity` spans
+                "partial": self.dropped > 0,
                 "ring_capacity": self.capacity,
             },
         }

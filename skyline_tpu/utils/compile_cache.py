@@ -12,6 +12,48 @@ BASELINE.md).
 from __future__ import annotations
 
 import os
+import threading
+
+# process-wide persistent-cache effectiveness counters, fed by JAX's
+# monitoring events (registered once in enable_compile_cache): a rising
+# miss count on a warm cache is a retrace regression visible on /metrics
+# without running the jaxpr audit
+_stats_lock = threading.Lock()
+_stats = {"hits": 0, "misses": 0}  # guarded-by: _stats_lock
+_listener_registered = False  # guarded-by: _stats_lock
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(event, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        with _stats_lock:
+            _stats["hits"] += 1
+    elif event == _MISS_EVENT:
+        with _stats_lock:
+            _stats["misses"] += 1
+
+
+def _register_listener() -> None:
+    global _listener_registered
+    with _stats_lock:
+        if _listener_registered:
+            return
+        _listener_registered = True
+    try:  # jax.monitoring is stable API but guard against slim builds
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass
+
+
+def compile_cache_stats() -> dict:
+    """{"hits": n, "misses": n} for the bench ``analysis`` block and the
+    ``compile_cache.{hits,misses}`` Prometheus counters."""
+    with _stats_lock:
+        return dict(_stats)
 
 
 def default_cache_dir() -> str:
@@ -47,4 +89,5 @@ def enable_compile_cache(cache_dir: str | None = None) -> str:
     d = cache_dir or default_cache_dir()
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _register_listener()
     return d

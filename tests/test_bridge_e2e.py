@@ -105,8 +105,8 @@ def test_worker_step_polls_triggers_before_data_and_applies_after():
                                        query_poll(*a, **k))[1]
     real_records = w.engine.process_records
     real_trigger = w.engine.process_trigger
-    w.engine.process_records = lambda *a: (events.append("records"),
-                                           real_records(*a))[1]
+    w.engine.process_records = lambda *a, **k: (events.append("records"),
+                                                real_records(*a, **k))[1]
     w.engine.process_trigger = lambda t: (events.append("trigger"),
                                           real_trigger(t))[1]
 

@@ -1,0 +1,320 @@
+"""Freshness lineage, kernel profiler, flight recorder, and SLO engine
+unit tests (ISSUE 8): stage-window watermark flow, labeled Prometheus
+families, staleness fallback on reads, burn-rate windows with an injected
+clock, and the end-to-end engine lineage on a real query."""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.serve import SnapshotStore
+from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
+from skyline_tpu.telemetry import (
+    FlightRecorder,
+    FreshnessTracker,
+    KernelProfiler,
+    SloEngine,
+    Telemetry,
+)
+from skyline_tpu.telemetry.profiler import n_bucket
+
+
+# --------------------------------------------------------- freshness tracker
+
+
+def _counts(fr):
+    return {s: h.count for s, h in fr._hists.items()}
+
+
+def test_tracker_stage_flow_and_watermark():
+    fr = FreshnessTracker()
+    # two batches land, then the cascade drains: flush lag is measured from
+    # the OLDEST waiting event-time, and the published watermark is the
+    # newest event-time that reached the snapshot
+    fr.on_ingest(1000.0, 1500.0, now_ms=1600.0)
+    fr.on_ingest(1200.0, 2000.0, now_ms=2100.0)
+    fr.on_flush(now_ms=3000.0)
+    fr.on_merge(now_ms=4000.0)
+    wm = fr.on_publish(now_ms=5000.0)
+    assert wm == 2000.0
+    assert _counts(fr) == {
+        "ingest": 2, "flush": 1, "merge": 1, "publish": 1, "read": 0,
+    }
+    # lag at each transition = now - oldest waiting event-time
+    assert fr._hists["flush"].quantile(1.0) == pytest.approx(2000.0)
+    assert fr._hists["merge"].quantile(1.0) == pytest.approx(3000.0)
+    assert fr._hists["publish"].quantile(1.0) == pytest.approx(4000.0)
+    st = fr.stats()
+    assert st["batches"] == 2
+    assert st["published_wm_ms"] == 2000.0
+
+
+def test_tracker_empty_transitions_are_idempotent():
+    fr = FreshnessTracker()
+    # nothing pending: flush/merge/publish record no samples and the
+    # watermark stays unset
+    fr.on_flush(now_ms=10.0)
+    fr.on_merge(now_ms=20.0)
+    assert fr.on_publish(now_ms=30.0) is None
+    assert _counts(fr) == {
+        "ingest": 0, "flush": 0, "merge": 0, "publish": 0, "read": 0,
+    }
+    # a second flush after the window drained records nothing either
+    fr.on_ingest(100.0, 100.0, now_ms=100.0)
+    fr.on_flush(now_ms=110.0)
+    fr.on_flush(now_ms=120.0)
+    assert _counts(fr)["flush"] == 1
+
+
+def test_tracker_watermark_monotone_and_restore():
+    fr = FreshnessTracker()
+    fr.on_ingest(0.0, 5000.0, now_ms=5000.0)
+    fr.on_flush(now_ms=5001.0)
+    fr.on_merge(now_ms=5002.0)
+    assert fr.on_publish(now_ms=5003.0) == 5000.0
+    # an older batch flowing later must not move the watermark backwards
+    fr.on_ingest(100.0, 200.0, now_ms=5100.0)
+    fr.on_flush(now_ms=5101.0)
+    fr.on_merge(now_ms=5102.0)
+    assert fr.on_publish(now_ms=5103.0) == 5000.0
+    # restore is monotone-max too: a stale checkpoint can't regress it
+    fr.restore(4000.0)
+    assert fr.stats()["published_wm_ms"] == 5000.0
+    fr.restore(9000.0)
+    assert fr.stats()["published_wm_ms"] == 9000.0
+    fr.restore(None)  # no-op
+    assert fr.stats()["published_wm_ms"] == 9000.0
+
+
+def test_tracker_registers_on_hub_and_renders_labeled(prom_parse):
+    tel = Telemetry()
+    fr = FreshnessTracker(tel)
+    fr.on_ingest(1000.0, 1000.0, now_ms=1250.0)
+    fr.on_read(42.0)
+    series = prom_parse(tel.render_prometheus())
+    types = series.pop("__types__")
+    assert types["skyline_freshness_lag_ms"] == "histogram"
+    buckets = series["skyline_freshness_lag_ms_bucket"]
+    stages = {lbl["stage"] for lbl, _ in buckets}
+    assert stages == {"ingest", "flush", "merge", "publish", "read"}
+    # per-series cumulative counts: ingest saw one 250ms lag, read one 42ms
+    counts = {
+        lbl["stage"]: v
+        for lbl, v in series["skyline_freshness_lag_ms_count"]
+    }
+    assert counts["ingest"] == 1.0 and counts["read"] == 1.0
+    assert counts["flush"] == 0.0
+    read_lag = fr.stats()["read_lag_p99_ms"]
+    assert read_lag == pytest.approx(42.0)
+
+
+# ------------------------------------------------- snapshot-store staleness
+
+
+def test_snapshot_store_staleness_and_fallback():
+    store = SnapshotStore()
+    pts = np.zeros((3, 2), dtype=np.float32)
+    # no event watermark anywhere: staleness falls back to snapshot age
+    store.publish(pts, query_id="q")
+    rs = store.read()
+    assert rs.staleness_ms == rs.age_ms
+    # an event-stamped publish: staleness is measured from the watermark
+    store.note_ingest(event_ms=123.0)
+    store.publish(np.ones((3, 2), dtype=np.float32), query_id="q")
+    snap = store.latest()
+    assert snap.event_wm_ms == 123.0
+    assert snap.to_doc()["event_wm_ms"] == 123.0
+    rs = store.read()
+    assert rs.staleness_ms > rs.age_ms  # epoch 123ms is ancient
+    assert store.stats()["published_event_wm_ms"] == 123.0
+
+
+def test_snapshot_store_restore_keeps_watermark():
+    store = SnapshotStore()
+    pts = np.zeros((2, 2), dtype=np.float32)
+    store.restore_state(pts, version=7, watermark_id=10, event_wm_ms=555.0)
+    assert store.latest().event_wm_ms == 555.0
+    assert store.stats()["event_watermark_ms"] == 555.0
+    # a later publish with no fresh stamp inherits the restored watermark
+    store.publish(np.ones((2, 2), dtype=np.float32), query_id="q")
+    assert store.latest().event_wm_ms == 555.0
+
+
+# ------------------------------------------------------------ kernel profiler
+
+
+def test_n_bucket_powers_of_two():
+    assert [n_bucket(n) for n in (0, 1, 2, 3, 5, 64, 65)] == [
+        0, 1, 2, 4, 8, 64, 128,
+    ]
+
+
+def test_profiler_signatures_and_retrace_canary():
+    prof = KernelProfiler(backend="testbk")
+    for n in (100, 120, 300):  # 128-bucket x2, 512-bucket x1
+        with prof.record("merge_step", 4, n):
+            pass
+    doc = prof.doc()
+    assert doc["signatures"] == 2 and doc["dispatches"] == 3
+    by_bucket = {r["n_bucket"]: r for r in doc["kernels"]}
+    assert by_bucket[128]["calls"] == 2
+    assert by_bucket[512]["calls"] == 1
+    # first_call_ms (the retrace canary) is pinned at the first dispatch
+    assert by_bucket[128]["first_call_ms"] is not None
+    assert doc["retraces_per_variant"] == {"merge_step": 2}
+    # attribution: the profiler timed everything the phase saw (use the
+    # unrounded total — the doc's is rounded to 3 decimals and these empty
+    # dispatches take microseconds)
+    doc = prof.doc(phase_total_ms=prof.total_wall_ms())
+    assert doc["attributed_share"] == pytest.approx(1.0, rel=1e-3)
+
+
+def test_profiler_cost_thunk_once_and_defensive():
+    calls = []
+
+    def thunk():
+        calls.append(1)
+        return [{"flops": 10.0, "bytes accessed": 20.0}]  # older-jaxlib shape
+
+    prof = KernelProfiler(backend="testbk")
+    for _ in range(3):
+        with prof.record("v", 2, 8, cost_thunk=thunk):
+            pass
+    assert len(calls) == 1  # AOT cost runs once per signature
+    (row,) = prof.doc()["kernels"]
+    assert row["cost"] == {"flops": 10.0, "bytes_accessed": 20.0}
+
+    def broken():
+        raise RuntimeError("no cost on this backend")
+
+    with prof.record("v2", 2, 8, cost_thunk=broken):
+        pass  # must not raise
+    rows = {r["variant"]: r for r in prof.doc()["kernels"]}
+    assert "cost" not in rows["v2"]
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+def test_flight_recorder_bounded_and_partial():
+    fl = FlightRecorder(capacity=4)
+    for i in range(10):
+        fl.note("merge.launch", path="flat", i=i)
+    doc = fl.doc()
+    assert len(doc["entries"]) == 4
+    assert doc["recorded_total"] == 10 and doc["partial"] is True
+    assert [e["i"] for e in doc["entries"]] == [6, 7, 8, 9]
+    assert doc["entries"][-1]["seq"] == 10
+
+
+def test_flight_recorder_dump_json_line():
+    import io
+    import json
+
+    fl = FlightRecorder(capacity=8)
+    fl.note("flush.dispatch", rows=5)
+    buf = io.StringIO()
+    fl.dump("crash: InjectedCrash: boom", stream=buf)
+    line = buf.getvalue().strip()
+    assert line.startswith("skyline-flight-recorder: ")
+    doc = json.loads(line.split(": ", 1)[1])
+    assert doc["reason"].startswith("crash:")
+    assert doc["entries"][0]["kind"] == "flush.dispatch"
+
+
+# ----------------------------------------------------------------- SLO engine
+
+
+def test_slo_engine_healthy_and_breach():
+    tel = Telemetry()
+    t = {"now": 0.0}
+    slo = SloEngine(tel, clock=lambda: t["now"])
+    # healthy: reads well under the 50ms target
+    for _ in range(100):
+        tel.histogram("serve_read_ms").observe(1.0)
+    doc = slo.evaluate()
+    assert doc["ok"] is True
+    assert set(doc["slos"]) == {
+        "read_p99", "freshness_p99", "shed_fraction", "restart_rate",
+    }
+    # now every read blows the target: burn must exceed 1 on BOTH windows
+    t["now"] = 30.0
+    for _ in range(400):
+        tel.histogram("serve_read_ms").observe(5000.0)
+    t["now"] = 60.0
+    doc = slo.evaluate()
+    read = doc["slos"]["read_p99"]
+    assert read["breach"] is True and doc["ok"] is False
+    for w in ("fast", "slow"):
+        assert read["windows"][w]["burn_rate"] > 1.0
+    # the untouched SLOs stay green
+    assert doc["slos"]["shed_fraction"]["breach"] is False
+    assert doc["slos"]["restart_rate"]["breach"] is False
+
+
+def test_slo_restart_rate_uses_counter():
+    tel = Telemetry()
+    t = {"now": 0.0}
+    slo = SloEngine(tel, clock=lambda: t["now"])
+    slo.evaluate()
+    # 6/h allowed; 30 restarts in 10 minutes is a 30x burn on the fast
+    # window and (cold slow window -> same span) the slow one too
+    for _ in range(30):
+        tel.inc("resilience.restarts")
+    t["now"] = 600.0
+    doc = slo.evaluate()
+    rr = doc["slos"]["restart_rate"]
+    assert rr["breach"] is True
+    assert rr["windows"]["fast"]["events"] == 30
+
+
+# --------------------------------------------------- engine lineage e2e (cpu)
+
+
+def _run_query(tel, event_ms=None):
+    # dims=3: the 2-D fast path bypasses the profiled kernel dispatch sites
+    eng = SkylineEngine(EngineConfig(parallelism=2, dims=3), telemetry=tel)
+    store = SnapshotStore()
+    eng.attach_snapshots(store)
+    rng = np.random.default_rng(0)
+    ids = np.arange(1, 301, dtype=np.int64)
+    vals = rng.uniform(1, 999, size=(300, 3)).astype(np.float32)
+    eng.process_records(ids, vals, event_ms=event_ms)
+    eng.process_trigger("q1,0")
+    (result,) = eng.poll_results()
+    return eng, store, result
+
+
+def test_engine_lineage_end_to_end():
+    tel = Telemetry()
+    eng, store, result = _run_query(tel, event_ms=(1000.0, 2000.0))
+    fr = eng.stats()["freshness"]
+    for stage in ("ingest", "flush", "merge", "publish"):
+        assert fr["stages"][stage]["count"] >= 1, (stage, fr)
+    assert fr["published_wm_ms"] == 2000.0
+    assert store.latest().event_wm_ms == 2000.0
+    # the store-level read computes staleness from the published watermark
+    rs = store.read()
+    assert rs.staleness_ms is not None and rs.staleness_ms > 0
+
+
+def test_engine_profile_registry_populated():
+    tel = Telemetry()
+    eng, _, _ = _run_query(tel)
+    kp = eng.stats()["kernel_profile"]
+    assert kp["signatures"] >= 1 and kp["dispatches"] >= 1
+    assert any(r["calls"] >= 1 for r in kp["kernels"])
+    # the same registry serves /profile via the shared hub
+    assert tel.profiler.doc()["signatures"] == kp["signatures"]
+
+
+def test_engine_freshness_off_leaves_stats_clean(monkeypatch):
+    monkeypatch.setenv("SKYLINE_FRESHNESS", "0")
+    monkeypatch.setenv("SKYLINE_KERNEL_PROFILE", "0")
+    eng, store, result = _run_query(None)
+    st = eng.stats()
+    assert "freshness" not in st and "kernel_profile" not in st
+    # no tracker -> no event stamp anywhere; reads fall back to age
+    assert store.latest().event_wm_ms is None
+    rs = store.read()
+    assert rs.staleness_ms == rs.age_ms
+    assert result["skyline_size"] > 0

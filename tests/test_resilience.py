@@ -704,6 +704,65 @@ def test_serve_plane_restored_from_wal(rng, tmp_path):
     w2.close()
 
 
+def test_event_watermark_survives_restart(rng, tmp_path):
+    """Freshness lineage durability (ISSUE 8): the restored serve head
+    carries exactly the event watermark the pre-crash worker published
+    (checkpoint barrier for the base + WAL ``ewm`` for post-barrier
+    deltas), the restored engine's tracker is re-seeded with it, and
+    ``staleness_ms`` is monotone non-increasing across the restored ->
+    live-publish transition."""
+    import time
+
+    bus = MemoryBus()
+    _feed(bus, anti_correlated(rng, 300, 2, 0, 10000))
+    w1 = _worker(bus, tmp_path, serve=True)
+    bus.produce("queries", format_trigger(0, 0))
+    while w1.step(max_records=128):
+        pass
+    w1.checkpoint_now()  # barrier embeds the head (incl. event_wm_ms)
+    # a post-barrier publish: restore must take THIS wm from the WAL delta
+    _feed(bus, anti_correlated(rng, 100, 2, 0, 10000), start_id=300)
+    bus.produce("queries", format_trigger(1, 0))
+    while w1.step(max_records=128):
+        pass
+    head = w1._snap_store.latest()
+    wm_live = head.event_wm_ms
+    assert wm_live is not None  # worker stamps the poll-time proxy
+    assert w1.engine.freshness.stats()["published_wm_ms"] == pytest.approx(
+        wm_live
+    )
+    w1._wal.flush(force=True)
+    w1.close()
+
+    w2 = _worker(bus, tmp_path, serve=True)
+    store = w2._snap_store
+    assert store.restored
+    # restored == uninterrupted: the watermark is exactly the one the
+    # pre-crash worker published, not re-stamped at restore time
+    assert store.latest().event_wm_ms == wm_live
+    assert store.stats()["event_watermark_ms"] == wm_live
+    assert w2.engine.freshness.stats()["published_wm_ms"] == pytest.approx(
+        wm_live
+    )
+    time.sleep(0.05)  # let the restored head age measurably
+    status, doc = _get(f"http://127.0.0.1:{w2.serve_server.port}/skyline")
+    assert status == 200 and doc["restored"] is True
+    stale_restored = doc["staleness_ms"]
+    assert stale_restored >= 40.0  # aged at least through the sleep
+
+    # a live publish advances the watermark monotonically; staleness must
+    # not jump up across the restored -> live transition
+    _feed(bus, anti_correlated(rng, 50, 2, 0, 10000), start_id=400)
+    bus.produce("queries", format_trigger(2, 0))
+    while w2.step(max_records=128):
+        pass
+    assert store.latest().event_wm_ms >= wm_live
+    status, doc = _get(f"http://127.0.0.1:{w2.serve_server.port}/skyline")
+    assert status == 200 and "restored" not in doc
+    assert doc["staleness_ms"] <= stale_restored
+    w2.close()
+
+
 # --------------------------------------------------------------------------
 # kafkalite: bounded reconnect — clients survive a broker restart
 # --------------------------------------------------------------------------

@@ -268,9 +268,9 @@ def test_arrays_plane_oversized_batch_chunks_to_max_records(rng):
     seen = []
     orig = w.engine.process_records
 
-    def spy(ids_, vals_, now_ms=None):
+    def spy(ids_, vals_, now_ms=None, event_ms=None):
         seen.append(ids_.shape[0])
-        return orig(ids_, vals_, now_ms=now_ms)
+        return orig(ids_, vals_, now_ms=now_ms, event_ms=event_ms)
 
     w.engine.process_records = spy
     got = w.step(max_records=256)
