@@ -304,11 +304,30 @@ def serve_leg(d: int, algo: str) -> dict:
     shed = sum(1 for c in shed_codes if c == 429)
     read_pcts = read_hist.percentiles(50, 99)
     st = eng.stats()
+    # EXPLAIN-plane stamp (ISSUE 9): ring state from this run's query plus
+    # the record's serialized size and the pure ring-add cost — the e2e
+    # on/off overhead lives in benchmarks/explain.py -> explain_ab.json
+    explain = dict(st.get("explain", {"skipped": True}))
+    latest = hub.explain.latest()
+    if latest is not None:
+        from skyline_tpu.telemetry.explain import ExplainRecorder
+
+        explain["record_bytes"] = len(json.dumps(latest).encode())
+        explain["path"] = (latest.get("merge") or {}).get("path")
+        scratch = ExplainRecorder(256)
+        reps = 2000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            scratch.add(dict(latest))
+        explain["ring_add_us"] = round(
+            (time.perf_counter() - t0) / reps * 1e6, 2
+        )
     return {
         # end-to-end lineage + per-kernel registry from the same run the
         # reads above hit; child_main lifts these to top-level artifact keys
         "freshness": st.get("freshness", {}),
         "kernel_profile": st.get("kernel_profile", {}),
+        "explain": explain,
         "read_p50_ms": round(read_pcts["p50"], 2),
         "read_p99_ms": round(read_pcts["p99"], 2),
         "reads_ok": sum(1 for c in codes if c == 200),
@@ -440,6 +459,7 @@ def child_main(backend: str) -> None:
     # scripts/bench_compare.py can gate on freshness.read_lag_p99_ms
     freshness = serve.pop("freshness", {"skipped": True})
     kernel_profile = serve.pop("kernel_profile", {"skipped": True})
+    explain = serve.pop("explain", {"skipped": True})
     try:
         merge_cache, merge_tree, flush_cascade = merge_cache_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
@@ -486,6 +506,7 @@ def child_main(backend: str) -> None:
                 "flush_cascade": flush_cascade,
                 "freshness": freshness,
                 "kernel_profile": kernel_profile,
+                "explain": explain,
                 "analysis": analysis,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }

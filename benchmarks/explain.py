@@ -1,0 +1,209 @@
+"""A/B: EXPLAIN-plane overhead (ISSUE 9) — per-query plan records must be
+free on the jitted path and near-free off it.
+
+Three legs, all on one process:
+
+- e2e:    identical streams (multi-trigger, so the cache-hit and delta
+  paths are exercised, not just the cold full merge) driven through an
+  engine with SKYLINE_EXPLAIN off vs on — skyline byte-identity asserted
+  for EVERY trigger (plans are annotated host-side only; nothing may
+  enter a jitted computation), the wall delta is the plane's tax and
+  must stay within run-to-run noise.
+- record: the per-query cost of the finalizer's primitives — a
+  cascade/kernel snapshot diff plus one ring add — i.e. what each
+  answer pays with the plane on.
+- render: format_plan / plan_diff wall for a realistic record (the CLI
+  and /explain presentation cost; never on the query path).
+
+Writes ``artifacts/explain_ab.json``.
+
+Usage: python benchmarks/explain.py [--n 20000] [--d 4] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _drive(rows, d: int, explain_on: bool):
+    """One stream -> three triggers (full merge, cache hit, delta) through
+    an engine; returns (wall_s, per-trigger skyline bytes, stats). The
+    knob is flipped via env BEFORE engine construction (read at ctor);
+    the telemetry hub is present in BOTH legs so the delta isolates the
+    EXPLAIN plane, not the whole observability stack."""
+    from skyline_tpu.serve import SnapshotStore
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.telemetry import Telemetry
+
+    os.environ["SKYLINE_EXPLAIN"] = "1" if explain_on else "0"
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, dims=d, domain_max=10000.0,
+                     buffer_size=4096, emit_skyline_points=True),
+        telemetry=Telemetry(),
+    )
+    eng.attach_snapshots(SnapshotStore())
+    n = rows.shape[0]
+    ids = np.arange(n, dtype=np.int64)
+    cut = n - max(1024, n // 8)  # tail re-ingest dirties a subset
+    answers = []
+    t0 = time.perf_counter()
+    chunk = 4096
+    for i in range(0, cut, chunk):
+        eng.process_records(ids[i : i + chunk], rows[i : i + chunk])
+    for trigger in ("full,0", "hit,0"):
+        eng.process_trigger(trigger)
+        (result,) = eng.poll_results()
+        pts = np.asarray(result["skyline_points"], dtype=np.float32)
+        answers.append((int(result["skyline_size"]), pts.tobytes()))
+    for i in range(cut, n, chunk):
+        eng.process_records(ids[i : i + chunk], rows[i : i + chunk])
+    eng.process_trigger("delta,0")
+    (result,) = eng.poll_results()
+    pts = np.asarray(result["skyline_points"], dtype=np.float32)
+    answers.append((int(result["skyline_size"]), pts.tobytes()))
+    dt = time.perf_counter() - t0
+    return dt, answers, eng.stats()
+
+
+def bench_e2e(n: int, d: int, repeats: int) -> dict:
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    rows = anti_correlated(rng, n, d, 0, 10000)
+    off_s, on_s = [], []
+    explain_block = {}
+    record_bytes = 0
+    for _ in range(repeats + 1):  # first round warms the executables
+        off_dt, off_answers, _ = _drive(rows, d, explain_on=False)
+        on_dt, on_answers, st = _drive(rows, d, explain_on=True)
+        # acceptance: byte-identical skylines with the plane on and off,
+        # for every merge path the run exercised
+        assert on_answers == off_answers, "EXPLAIN changed the skyline"
+        off_s.append(off_dt)
+        on_s.append(on_dt)
+        explain_block = st["explain"]
+    off_ms = float(np.median(off_s[1:]) * 1000.0)
+    on_ms = float(np.median(on_s[1:]) * 1000.0)
+    return {
+        "n": n,
+        "d": d,
+        "triggers": 3,
+        "off_ms": round(off_ms, 1),
+        "on_ms": round(on_ms, 1),
+        "overhead_pct": round((on_ms / off_ms - 1.0) * 100.0, 1),
+        "byte_identical": True,
+        "plans_recorded": explain_block["recorded_total"],
+        "record_bytes": record_bytes or None,
+    }
+
+
+def bench_record(queries: int = 20_000) -> dict:
+    """The finalizer's primitives at their per-query call rate: two
+    counter-snapshot diffs + one ring add per answered query."""
+    from skyline_tpu.telemetry.explain import (
+        ExplainRecorder,
+        QueryPlan,
+        cascade_delta,
+        kernel_delta,
+    )
+
+    rec = ExplainRecorder(256)
+    kernels = {
+        ("merge_step", 8, 4096, "cpu", False): (3, 12.0),
+        ("sweep", 2, 1024, "cpu", False): (1, 2.0),
+        ("tree_pair", 8, 2048, "cpu", True): (2, 7.5),
+    }
+    cascade = {
+        "prefilter_seen": 4096, "prefilter_dropped": 512,
+        "bf16_resolved": 3584, "prefilter_enabled": True,
+        "mixed_precision": True,
+    }
+    t0 = time.perf_counter()
+    for i in range(queries):
+        plan = QueryPlan(f"t-{i}", f"q{i}")
+        plan.merge = {"path": "tree_delta", "cached": False,
+                      "dirty": [1, 3], "clean": [0, 2, 4, 5, 6, 7]}
+        plan.cascade = cascade_delta({}, cascade)
+        plan.kernels = kernel_delta({}, kernels)
+        plan.publish = {"version": i, "deduped": False, "event_wm_ms": None}
+        rec.add(plan.to_doc())
+    per_query_us = (time.perf_counter() - t0) / queries * 1e6
+    doc = rec.latest()
+    return {
+        "queries": queries,
+        "us_per_query": round(per_query_us, 2),
+        "record_bytes": len(json.dumps(doc).encode()),
+        "ring_depth": len(rec),
+    }
+
+
+def bench_render(renders: int = 5_000) -> dict:
+    from skyline_tpu.telemetry.explain import (
+        QueryPlan,
+        format_plan,
+        plan_diff,
+    )
+
+    plan = QueryPlan("t-r", "qr")
+    plan.merge = {"path": "tree", "cached": False,
+                  "dirty": list(range(8)), "clean": [],
+                  "epoch_key": "ab" * 16, "skyline_size": 421}
+    plan.tree = {"levels": 3, "considered": 8, "partitions_pruned": 2,
+                 "pruned": [{"partition": 5, "witness": 1},
+                            {"partition": 6, "witness": 1}]}
+    plan.kernels = [{"variant": "merge_step", "d": 8, "n_bucket": 4096,
+                     "backend": "cpu", "mp": False, "calls": 3,
+                     "wall_ms": 11.2}]
+    doc = plan.to_doc()
+    t0 = time.perf_counter()
+    for _ in range(renders):
+        format_plan(doc)
+    fmt_us = (time.perf_counter() - t0) / renders * 1e6
+    t0 = time.perf_counter()
+    for _ in range(renders):
+        plan_diff(doc, doc)
+    diff_us = (time.perf_counter() - t0) / renders * 1e6
+    return {
+        "renders": renders,
+        "format_plan_us": round(fmt_us, 2),
+        "plan_diff_us": round(diff_us, 2),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="EXPLAIN plane overhead A/B")
+    ap.add_argument("--n", type=int, default=20000)
+    ap.add_argument("--d", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "artifacts", "explain_ab.json")
+    )
+    a = ap.parse_args(argv)
+
+    record = bench_record()
+    e2e = bench_e2e(a.n, a.d, a.repeats)
+    e2e["record_bytes"] = record["record_bytes"]
+    result = {
+        "e2e": e2e,
+        "record": record,
+        "render": bench_render(),
+    }
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print(f"wrote {a.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -140,7 +140,10 @@ try:
                  "skyline_freshness_lag_ms_bucket",
                  "skyline_telemetry_spans_dropped_total",
                  "skyline_compile_cache_hits_total",
-                 "skyline_compile_cache_misses_total"):
+                 "skyline_compile_cache_misses_total",
+                 # EXPLAIN plane (ISSUE 9): per-query plans recorded
+                 # (registered at engine ctor, so exported even at zero)
+                 "skyline_explain_records_total"):
         assert want in body, f"{want} missing from exposition"
     for stage in ("ingest", "flush", "merge", "publish", "read"):
         assert f'stage="{stage}"' in body, \
@@ -180,6 +183,31 @@ try:
         assert {"fast", "slow"} <= set(s["windows"]), (name, s)
         assert s["breach"] is False, (name, s)
     print(f"[obs-smoke] /slo ok: {len(slo['slos'])} SLOs, no breach")
+
+    # EXPLAIN plane (ISSUE 9): every answered query left a complete plan
+    # in the ring; both surfaces serve it and /skyline inlines it. The
+    # second (deduped) trigger is the latest plan: a cache hit republished
+    # as version 1.
+    for base in (stats_base, serve_base):
+        with urllib.request.urlopen(f"{base}/explain", timeout=5) as r:
+            plan = json.load(r)
+        for block in ("merge", "cascade", "kernels", "publish", "timing"):
+            assert plan.get(block) is not None, (base, block, plan)
+        assert plan["merge"]["path"] == "cache_hit", plan["merge"]
+        assert plan["publish"]["version"] == 1, plan["publish"]
+        assert plan["publish"]["deduped"] is True, plan["publish"]
+        assert plan["trace_id"], plan
+        with urllib.request.urlopen(f"{base}/explain?version=1",
+                                    timeout=5) as r:
+            assert json.load(r)["publish"]["version"] == 1
+    with urllib.request.urlopen(f"{serve_base}/skyline?explain=1",
+                                timeout=5) as r:
+        inline = json.load(r)["explain"]
+    assert inline["trace_id"] == plan["trace_id"], inline
+    assert stats["explain"]["recorded_total"] >= 2, stats["explain"]
+    print(f"[obs-smoke] /explain ok: {stats['explain']['recorded_total']} "
+          f"plan(s), latest path={plan['merge']['path']} "
+          f"(v{plan['publish']['version']}, deduped)")
 
     # flight recorder: flushes + merges above left dispatch decisions in
     # the ring

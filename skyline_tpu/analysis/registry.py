@@ -285,6 +285,14 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_FLIGHT_RING", "int", 256,
        "flight-recorder ring capacity (last N engine decisions, "
        "/debug/flight and the crash dump)", "telemetry", runbook="§2j"),
+    _k("SKYLINE_EXPLAIN", "bool", True,
+       "per-query EXPLAIN plane: a causal QueryPlan per trigger (merge "
+       "path, prune witnesses, cascade + kernel attribution, publish "
+       "watermark) behind GET /explain and /skyline?explain=1",
+       "telemetry", runbook="§2k"),
+    _k("SKYLINE_EXPLAIN_RING", "int", 256,
+       "EXPLAIN plan ring capacity (last N finalized query plans)",
+       "telemetry", runbook="§2k"),
     _k("SKYLINE_SLO_FAST_WINDOW_S", "float", 300.0,
        "fast burn-rate window for the /slo evaluation", "telemetry/slo",
        runbook="§2j"),

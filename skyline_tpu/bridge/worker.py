@@ -60,7 +60,10 @@ class SkylineWorker:
         port (0 picks a free one; None disables): the engine publishes
         every completed global skyline as a versioned snapshot, and
         ``GET /skyline`` / ``POST /query`` / ``GET /deltas`` serve reads,
-        forced merges, and delta catch-up with admission control.
+        forced merges, and delta catch-up with admission control;
+        ``GET /explain`` (also on the stats port, and inline via
+        ``/skyline?explain=1``) returns the per-query EXPLAIN plan that
+        produced an answer (telemetry/explain.py, RUNBOOK §2k).
         ``serve_config``: a ``serve.ServeConfig`` overriding the admission
         and ring knobs (its ``port`` is overridden by ``serve_port``).
         ``tracer``: optional ``metrics.tracing.Tracer``; by default the

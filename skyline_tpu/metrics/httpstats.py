@@ -23,6 +23,8 @@ Endpoints:
                 compile-time canary, optional AOT cost_analysis figures)
   GET /slo      declarative SLO table with multi-window burn rates
   GET /debug/flight  bounded flight-recorder ring of dispatch decisions
+  GET /explain  one per-query EXPLAIN plan from the hub ring
+                (?version=N | ?trace_id=... | latest)
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -31,6 +33,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 _DASHBOARD = """<!doctype html>
 <html><head><meta charset="utf-8"><title>tpu-skyline worker</title>
@@ -147,42 +150,52 @@ class StatsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(handler):  # noqa: N805 — http.server API
-                if handler.path == "/healthz":
+                # handler.path carries the raw query string; split it so
+                # parameterized endpoints (/explain?version=) route like
+                # their bare forms
+                path, _, qs = handler.path.partition("?")
+                if path == "/healthz":
                     handler._reply(200, {"ok": True})
-                elif handler.path == "/stats":
+                elif path == "/stats":
                     try:
                         handler._reply(200, callback())
                     except Exception as e:
                         handler._reply(500, {"error": str(e)})
-                elif handler.path == "/metrics":
+                elif path == "/metrics":
                     try:
                         body, ctype = outer._render_metrics()
                         handler._reply_raw(200, body, ctype)
                     except Exception as e:
                         handler._reply(500, {"error": str(e)})
-                elif handler.path == "/trace":
+                elif path == "/trace":
                     doc = (
                         outer.telemetry.spans.to_chrome()
                         if outer.telemetry is not None
                         else {"traceEvents": []}
                     )
                     handler._reply(200, doc)
-                elif handler.path == "/profile":
+                elif path == "/profile":
                     if outer.telemetry is None:
                         handler._reply(404, {"error": "no telemetry hub"})
                     else:
                         handler._reply(200, outer.telemetry.profiler.doc())
-                elif handler.path == "/slo":
+                elif path == "/slo":
                     if outer.telemetry is None:
                         handler._reply(404, {"error": "no telemetry hub"})
                     else:
                         handler._reply(200, outer.telemetry.slo.evaluate())
-                elif handler.path == "/debug/flight":
+                elif path == "/debug/flight":
                     if outer.telemetry is None:
                         handler._reply(404, {"error": "no telemetry hub"})
                     else:
                         handler._reply(200, outer.telemetry.flight.doc())
-                elif handler.path in ("/", "/ui"):
+                elif path == "/explain":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        code, doc = outer._explain_doc(qs)
+                        handler._reply(code, doc)
+                elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
                     )
@@ -210,6 +223,27 @@ class StatsServer:
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+
+    def _explain_doc(self, qs: str) -> tuple[int, dict]:
+        """Resolve an /explain request against the hub's plan ring:
+        ``version=N`` → newest plan published under snapshot version N,
+        ``trace_id=...`` → span/flight join, neither → latest plan."""
+        params = {k: v[-1] for k, v in parse_qs(qs).items()}
+        rec = self.telemetry.explain
+        version = params.get("version")
+        if version is not None:
+            try:
+                version = int(version)
+            except ValueError:
+                return 400, {"error": f"bad version {version!r}"}
+            plan = rec.by_version(version)
+        elif params.get("trace_id"):
+            plan = rec.by_trace(params["trace_id"])
+        else:
+            plan = rec.latest()
+        if plan is None:
+            return 404, {"error": "no matching plan", "ring": rec.doc()}
+        return 200, plan
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

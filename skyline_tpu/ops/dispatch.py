@@ -186,6 +186,20 @@ def kernel_profile_enabled() -> bool:
     return env_bool("SKYLINE_KERNEL_PROFILE", True)
 
 
+def explain_enabled() -> bool:
+    """``SKYLINE_EXPLAIN`` gates the per-query EXPLAIN plane
+    (``telemetry/explain.py``): one ``QueryPlan`` minted per trigger and
+    annotated host-side along launch → tree/prune → harvest → publish,
+    served at ``GET /explain`` and inline via ``/skyline?explain=1``.
+    Cost is a handful of counter snapshots and small dict writes per
+    QUERY (zero per ingest batch, nothing inside jit), so default ON;
+    set ``0`` for the no-plan baseline (``benchmarks/explain.py`` A/B).
+    Read lazily at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_EXPLAIN", True)
+
+
 def profile_cost_enabled() -> bool:
     """``SKYLINE_PROFILE_COST`` additionally captures XLA
     ``cost_analysis()`` FLOPs/bytes per dispatch signature via a one-shot

@@ -328,6 +328,8 @@ class SkylineServer:
             await self._reply(writer, 200, self.telemetry.slo.evaluate())
         elif path == "/debug/flight" and method == "GET":
             await self._reply(writer, 200, self.telemetry.flight.doc())
+        elif path == "/explain" and method == "GET":
+            await self._explain(writer, params)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -455,18 +457,33 @@ class SkylineServer:
         # serialized doc caches minus its closing brace; the read-dependent
         # fields (age/lag/staleness) splice on as a tiny per-request suffix
         include_points = params.get("points") != "0"
-        prefix = self._cache_get((snap.version, "json", include_points))
+        # explain bodies MUST NOT share cache entries with plain reads:
+        # one flavor cached under the other's key would break the plain
+        # body's byte-stability (ISSUE 9 satellite). The plan itself also
+        # rides the volatile tail, never the cached prefix — deduped
+        # publishes can map several plans onto one snapshot version.
+        want_explain = params.get("explain") == "1"
+        prefix = self._cache_get(
+            (snap.version, "json", include_points, want_explain)
+        )
         if prefix is None:
             prefix = json.dumps(snap.to_doc(include_points=include_points))[
                 :-1
             ].encode()
-            self._cache_put((snap.version, "json", include_points), prefix)
+            self._cache_put(
+                (snap.version, "json", include_points, want_explain), prefix
+            )
         tail = (
             f', "age_ms": {round(rs.age_ms, 1)}'
             f', "version_lag": {rs.version_lag}'
             f', "staleness_ms": {round(rs.staleness_ms, 1)}'
             f', "stale": {"true" if not rs.fresh else "false"}'
         )
+        if want_explain:
+            plan = self.telemetry.explain.by_version(snap.version)
+            tail += ', "explain": ' + (
+                json.dumps(plan) if plan is not None else "null"
+            )
         # the freshness lineage's terminal stage: how old the newest event
         # a CLIENT actually saw was at response time (event-time when the
         # snapshot carries a watermark, publish-age otherwise)
@@ -482,6 +499,32 @@ class SkylineServer:
         await self._reply_raw(
             writer, 200, prefix + tail.encode() + b"}", "application/json"
         )
+
+    async def _explain(self, writer, params):
+        """One finalized QueryPlan from the hub's EXPLAIN ring:
+        ``?version=N`` maps a snapshot version to the newest plan that
+        published it, ``?trace_id=`` joins from a span / flight-ring row,
+        and no selector returns the latest plan. 404 carries the ring
+        summary so "evicted" vs "never recorded" is diagnosable."""
+        try:
+            version = _int_param(params, "version")
+        except ValueError as e:
+            await self._reply(writer, 400, {"error": str(e)})
+            return
+        trace = params.get("trace_id")
+        rec = self.telemetry.explain
+        if version is not None:
+            plan = rec.by_version(version)
+        elif trace:
+            plan = rec.by_trace(trace)
+        else:
+            plan = rec.latest()
+        if plan is None:
+            await self._reply(
+                writer, 404, {"error": "no matching plan", "ring": rec.doc()}
+            )
+            return
+        await self._reply(writer, 200, plan)
 
     async def _deltas(self, writer, params):
         ok, retry = self.admission.admit_read()

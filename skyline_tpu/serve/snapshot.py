@@ -133,6 +133,11 @@ class SnapshotStore:
         # clients can distinguish a recovered head from a live one
         self.restored = False  # guarded-by: self._write_lock
         self.restores = 0  # guarded-by: self._write_lock
+        # outcome flag of the most recent publish (True = deduped against
+        # the live snapshot): the EXPLAIN plane reads it right after its
+        # own publish call on the same engine thread, so the
+        # read-after-write is ordered; other readers tolerate torn reads
+        self.last_publish_deduped = False  # guarded-by: self._write_lock
 
     # -- writer side (engine thread) --------------------------------------
 
@@ -186,6 +191,7 @@ class SnapshotStore:
             ):
                 self.deduped += 1
                 self._advances = 0
+                self.last_publish_deduped = True
                 return self._latest
             pts = np.ascontiguousarray(points, dtype=np.float32)
             if pts.base is None or pts is points:
@@ -212,6 +218,7 @@ class SnapshotStore:
             self._source_key = source_key
             self.published += 1
             self.restored = False  # a live publish supersedes a recovered head
+            self.last_publish_deduped = False
         for cb in self._subscribers:
             cb(prev, snap)
         return snap

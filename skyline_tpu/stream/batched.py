@@ -63,6 +63,7 @@ from skyline_tpu.stream.window import (
     meshed_sfs_cleanup,
     meshed_sfs_round,
     partition_summaries_device,
+    prune_witness_mask,
     sfs_cleanup,
     sfs_round,
     sfs_round_single,
@@ -113,6 +114,7 @@ class _MergeHandle:
         "root_vals",
         "dirty",
         "clean_total",
+        "explain",
     )
 
     def __init__(self):
@@ -124,6 +126,11 @@ class _MergeHandle:
         self.root_vals = None
         self.dirty = None
         self.clean_total = 0
+        # the EXPLAIN QueryPlan riding this merge (telemetry/explain.py);
+        # annotated host-side at launch/tree/harvest, None when the plane
+        # is off — the handle carries it so overlapped merges attribute to
+        # the query that launched them, not whatever is current at harvest
+        self.explain = None
 
     def ready(self) -> bool:
         """True once harvest would not block (best-effort: backends without
@@ -292,6 +299,11 @@ class PartitionSet:
         # every dispatch site on the bare tracer-phase path
         self._profiler = None
         self._flight = None
+        # one-shot EXPLAIN plan sink: the engine parks the current query's
+        # QueryPlan here before launching its merge; global_merge_launch
+        # claims it onto the handle (and clears it) so annotation follows
+        # the merge, not the PartitionSet
+        self._explain = None
         self.merge_cache_hits = 0
         self.merge_cache_misses = 0
         self.merge_delta_merges = 0
@@ -306,6 +318,10 @@ class PartitionSet:
         self.merge_tree_merges = 0
         self.merge_partitions_pruned = 0
         self.last_tree_info: dict | None = None
+        # witness_of vector from the last prune pass (window.py
+        # prune_witness_mask) — the per-partition prune REASONS the
+        # EXPLAIN plane folds into a QueryPlan's tree block
+        self.last_prune_witness: np.ndarray | None = None
         # quantized-grid flush prefilter (ISSUE 5 stage 1): the device
         # handle pair (bounds, rep cell codes) launched async at flush
         # tails; the validated host copy is harvested lazily at the next
@@ -372,6 +388,12 @@ class PartitionSet:
         around already-timed regions — skyline bytes are unchanged."""
         self._profiler = profiler
         self._flight = flight
+
+    def set_explain(self, plan) -> None:
+        """Park the current query's ``QueryPlan`` for the next
+        ``global_merge_launch`` to claim (None clears). Host-side
+        annotation only — nothing the plan records enters a kernel."""
+        self._explain = plan
 
     def _kernel(self, variant: str, n: int, mp: bool = False, cost_thunk=None):
         """Profiling context for one merge-kernel dispatch (nullcontext
@@ -1649,6 +1671,9 @@ class PartitionSet:
         h.emit_points = emit_points
         h.key = self.epoch_key
         h.epoch = self._epoch.copy()
+        # claim the parked EXPLAIN plan (one-shot): it rides the handle so
+        # an overlapped merge annotates the query that launched it
+        h.explain, self._explain = self._explain, None
         use_cache = merge_cache_enabled() and self.mesh is None
         h.use_cache = use_cache
         cache = self._gm_cache if use_cache else None
@@ -1668,11 +1693,24 @@ class PartitionSet:
                 cache["g"],
                 self._cached_points() if emit_points else None,
             )
+            if h.explain is not None:
+                h.explain.merge = {
+                    "path": "cache_hit",
+                    "cached": True,
+                    "epoch_key": h.key.hex(),
+                    "dirty_fraction": 0.0,
+                    "dirty": [],
+                    "clean": np.flatnonzero(
+                        cache["counts"] > 0
+                    ).tolist(),
+                    "skyline_size": int(cache["g"]),
+                }
             return h
         self.merge_cache_misses += 1
         self._inc("merge.cache_miss")
         P = self.num_partitions
         dirty = None
+        dirty_mask = None
         if cache is not None:
             dirty_mask = self._epoch != cache["epoch"]
             self.last_dirty_fraction = float(dirty_mask.sum()) / P
@@ -1684,13 +1722,32 @@ class PartitionSet:
         use_tree = (
             self.mesh is None and self.dims > 2 and merge_tree_enabled()
         )
+        path = ("tree_delta" if dirty is not None and use_tree
+                else "delta" if dirty is not None
+                else "tree" if use_tree else "flat")
         self._fnote(
-            "merge.launch",
-            path=("tree_delta" if dirty is not None and use_tree
-                  else "delta" if dirty is not None
-                  else "tree" if use_tree else "flat"),
-            dirty_fraction=self.last_dirty_fraction,
+            "merge.launch", path=path, dirty_fraction=self.last_dirty_fraction,
         )
+        if h.explain is not None:
+            if dirty_mask is not None:
+                dirty_set = np.flatnonzero(dirty_mask).tolist()
+                clean_set = np.flatnonzero(~dirty_mask).tolist()
+            else:
+                # no cached epoch to diff against: everything recomputes
+                dirty_set = list(range(P))
+                clean_set = []
+            h.explain.merge = {
+                "path": path,
+                "cached": False,
+                "epoch_key": h.key.hex(),
+                # only meaningful when the cache plane computed it this
+                # launch; otherwise it's a stale carry-over
+                "dirty_fraction": (
+                    self.last_dirty_fraction if use_cache else None
+                ),
+                "dirty": dirty_set,
+                "clean": clean_set,
+            }
         stats = None
         if dirty is not None:
             h.dirty = dirty
@@ -1751,6 +1808,11 @@ class PartitionSet:
             drows = h.clean_total + int(counts[h.dirty].sum())
             self.merge_delta_rows += drows
             self._inc("merge.delta_rows", drows)
+            if h.explain is not None:
+                h.explain.merge["delta_rows"] = drows
+                h.explain.merge["clean_rows"] = int(h.clean_total)
+        if h.explain is not None and h.explain.merge is not None:
+            h.explain.merge["skyline_size"] = g
         pts = None
         if h.use_cache:
             # compact the survivors into the cache buffer even when the
@@ -1863,29 +1925,14 @@ class PartitionSet:
         return np.asarray(self._summary_dev)
 
     def _prune_mask(self, alive: np.ndarray) -> np.ndarray:
-        """The O(P²·d) bound-dominance prefilter: partition B is pruned
-        when some partition A's witness (its min-row-sum live point — a
-        REAL point, not a bound) strictly dominates B's min-corner, because
-        then the witness dominates every point of B
-        (``witness[k] < min_corner_B[k] <= b[k]``). Strict dominance is a
-        strict partial order, so simultaneous pruning is acyclic: every
-        pruned partition's dominator chain ends at a surviving partition's
-        witness, and at least one alive partition always survives —
-        dropping pruned partitions leaves the skyline byte-identical."""
-        d = self.dims
-        s = self._tree_summaries()
-        mins = s[:, :d]
-        wit = s[:, d : 2 * d]
-        pruned = np.zeros(self.num_partitions, dtype=bool)
-        for a in np.flatnonzero(alive):
-            w = wit[a]
-            if not np.all(np.isfinite(w)):
-                continue  # empty partition: +inf witness prunes nothing
-            dom = np.all(w[None, :] <= mins, axis=1) & np.any(
-                w[None, :] < mins, axis=1
-            )
-            dom[a] = False  # a witness never beats its own min-corner
-            pruned |= dom & alive
+        """The O(P²·d) bound-dominance prefilter (core now in
+        ``stream.window.prune_witness_mask`` — see its docstring for the
+        soundness argument). Keeps the per-partition witness reasons on
+        ``self.last_prune_witness`` for the EXPLAIN plane; the mask itself
+        is byte-for-byte the historical one."""
+        pruned, self.last_prune_witness = prune_witness_mask(
+            self._tree_summaries(), alive, self.dims
+        )
         return pruned
 
     def _merge_tree_full(self, h: _MergeHandle):
@@ -1899,6 +1946,14 @@ class PartitionSet:
             pruned = self._prune_mask(alive)
             npruned = int(pruned.sum())
             leaf_mask = alive & ~pruned
+            if h.explain is not None:
+                wit = self.last_prune_witness
+                h.explain.tree = {
+                    "pruned": [
+                        {"partition": int(b), "witness": int(wit[b])}
+                        for b in np.flatnonzero(pruned)
+                    ],
+                }
         else:
             leaf_mask = alive
         pids = np.flatnonzero(leaf_mask)
@@ -2001,6 +2056,13 @@ class PartitionSet:
             "candidates_per_level": cand,
             "pruned_fraction": (npruned / considered) if considered else 0.0,
         }
+        if h.explain is not None:
+            # the prune hook (full path only) may already have stashed the
+            # witness rows; fold the tree shape in beside them
+            tree = h.explain.tree or {"pruned": []}
+            tree.update(self.last_tree_info)
+            tree["considered"] = considered
+            h.explain.tree = tree
         return tree_stats_device(
             self._count_dev, root_pids, root_cnt, self.num_partitions
         )

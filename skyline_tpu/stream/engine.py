@@ -126,6 +126,9 @@ class _QueryState:
     # clock at dispatch, so the end-to-end "query" span is recordable
     trace_id: str | None = None
     span_t0_ns: int = 0
+    # EXPLAIN plane (telemetry/explain.py): the QueryPlan minted beside the
+    # trace id; annotated along the merge path, finalized at result emission
+    plan: object | None = None
 
 
 class SkylineEngine:
@@ -229,6 +232,7 @@ class SkylineEngine:
         # touches the jitted byte-identity path. Without a hub the engine
         # still owns private instances so bench legs get the stats blocks.
         from skyline_tpu.ops.dispatch import (
+            explain_enabled,
             freshness_enabled,
             kernel_profile_enabled,
         )
@@ -249,6 +253,17 @@ class SkylineEngine:
             profiler=self.profiler,
             flight=telemetry.flight if telemetry is not None else None,
         )
+        # EXPLAIN plane (ISSUE 9): one QueryPlan per trigger, landed in the
+        # hub's bounded ring. The marks anchor the per-query attribution
+        # windows — cascade counters and kernel dispatch counts since the
+        # PREVIOUS plan finalized belong to the next query's window.
+        self._explain_on = explain_enabled() and telemetry is not None
+        self._explain_cascade_mark: dict = {}
+        self._explain_kernel_mark: dict = {}
+        if self._explain_on:
+            # inc even when zero so the Prometheus series registers before
+            # the first query, not after it
+            telemetry.inc("explain.records", 0)
 
     def attach_snapshots(self, store) -> None:
         """Publish completed global skylines to ``store`` (a
@@ -420,23 +435,42 @@ class SkylineEngine:
             self.pset.sync_ingest_bookkeeping()
         qid, required = parse_trigger(payload)
         q = _QueryState(qid=qid, payload=payload, required=required, dispatch_ms=now_ms)
+        flight = None
         if self.telemetry is not None:
             q.trace_id = self.telemetry.mint_trace_id()
             q.span_t0_ns = time.perf_counter_ns()
+            # stamp this trigger's flush/launch decisions in the flight
+            # ring with its trace id so /debug/flight joins /trace and
+            # /explain instead of being time-correlated by eye
+            flight = self.telemetry.flight
+            flight.set_trace(q.trace_id)
+        if self._explain_on:
+            from skyline_tpu.telemetry.explain import QueryPlan
+
+            q.plan = QueryPlan(q.trace_id, qid)
+            # park it for global_merge_launch to claim onto its handle
+            self.pset.set_explain(q.plan)
         self._inflight[payload] = q
-        all_ready = all(
-            part.max_seen_id >= required or part.max_seen_id == -1
-            for part in self.partitions
-        )
-        if all_ready and self.mesh is None:
-            self._answer_all_device(q, now_ms)
-            return
-        for p in range(self.config.num_partitions):
-            part = self.partitions[p]
-            if part.max_seen_id >= required or part.max_seen_id == -1:
-                now_ms = self._answer(p, q, now_ms)
-            else:
-                self._pending_queries[p].append(q)
+        try:
+            all_ready = all(
+                part.max_seen_id >= required or part.max_seen_id == -1
+                for part in self.partitions
+            )
+            if all_ready and self.mesh is None:
+                self._answer_all_device(q, now_ms)
+                return
+            for p in range(self.config.num_partitions):
+                part = self.partitions[p]
+                if part.max_seen_id >= required or part.max_seen_id == -1:
+                    now_ms = self._answer(p, q, now_ms)
+                else:
+                    self._pending_queries[p].append(q)
+        finally:
+            if flight is not None:
+                flight.set_trace(None)
+            # a plan the merge never claimed (host path, pending barrier)
+            # must not leak onto a later query's merge
+            self.pset.set_explain(None)
 
     def _recheck_pending(self, p: int, now_ms: float) -> float:
         """Returns the advanced clock (answers add their snapshot wall so
@@ -584,10 +618,18 @@ class SkylineEngine:
             self.snapshots.publish(points, **meta)
             return
         t0 = time.perf_counter_ns()
-        self.snapshots.publish(points, **meta)
+        snap = self.snapshots.publish(points, **meta)
         self.telemetry.spans.record(
             "publish", t0, time.perf_counter_ns(), trace_id=q.trace_id
         )
+        if q.plan is not None and snap is not None:
+            # a deduped publish returns the EXISTING snapshot — the plan
+            # still records which version its answer's bytes live under
+            q.plan.publish = {
+                "version": int(snap.version),
+                "deduped": bool(self.snapshots.last_publish_deduped),
+                "event_wm_ms": meta.get("event_wm_ms"),
+            }
 
     def _emit_result(
         self,
@@ -634,8 +676,75 @@ class SkylineEngine:
                     trace_id=q.trace_id,
                     args={"query_id": q.qid, "skyline_size": skyline_size},
                 )
+        if q.plan is not None:
+            self._finalize_plan(
+                q,
+                skyline_size=skyline_size,
+                local_ms=local_ms,
+                global_ms=global_ms,
+                total_ms=total_ms,
+                latency_ms=latency_ms,
+            )
         self._results.append(result)
         self._inflight.pop(q.payload, None)
+
+    def _finalize_plan(
+        self, q, *, skyline_size, local_ms, global_ms, total_ms, latency_ms
+    ) -> None:
+        """Close out a query's EXPLAIN plan: attribute the window's
+        flush-cascade and kernel-dispatch deltas, stamp the timing
+        decomposition, land the record in the hub ring, and nest an
+        ``explain/<path>`` child span under the query span. Observability
+        must never take the answer down, so the whole tail is defensive."""
+        try:
+            from skyline_tpu.telemetry.explain import (
+                cascade_delta,
+                kernel_delta,
+            )
+
+            plan = q.plan
+            if plan.merge is None:
+                # per-partition host merge (mesh, pending barriers,
+                # timeouts): no device merge claimed the plan
+                plan.merge = {"path": "host", "cached": False,
+                              "skyline_size": int(skyline_size)}
+            cascade_now = self.pset.flush_cascade_stats()
+            plan.cascade = cascade_delta(
+                self._explain_cascade_mark, cascade_now
+            )
+            self._explain_cascade_mark = cascade_now
+            if self.profiler is not None:
+                kernels_now = self.profiler.snapshot_counts()
+                plan.kernels = kernel_delta(
+                    self._explain_kernel_mark, kernels_now
+                )
+                self._explain_kernel_mark = kernels_now
+            plan.timing = {
+                "local_ms": round(float(local_ms), 3),
+                "global_ms": round(float(global_ms), 3),
+                "total_ms": round(float(total_ms), 3),
+                "latency_ms": round(float(latency_ms), 3),
+            }
+            self.telemetry.explain.add(plan.to_doc())
+            self.telemetry.inc("explain.records")
+            if q.span_t0_ns:
+                self.telemetry.spans.record(
+                    f"explain/{plan.merge.get('path')}",
+                    q.span_t0_ns,
+                    time.perf_counter_ns(),
+                    trace_id=q.trace_id,
+                    tid=3,
+                    args={
+                        "path": plan.merge.get("path"),
+                        "pruned": (plan.tree or {}).get(
+                            "partitions_pruned", 0
+                        ),
+                        "kernels": len(plan.kernels),
+                        "version": (plan.publish or {}).get("version"),
+                    },
+                )
+        except Exception:
+            pass
 
     def _answer_all_device(self, q: _QueryState, now_ms: float) -> None:
         """All barriers passed at dispatch: answer every partition and run
@@ -847,6 +956,8 @@ class SkylineEngine:
             },
             "flush_cascade": self.pset.flush_cascade_stats(),
         }
+        if self._explain_on:
+            out["explain"] = self.telemetry.explain.doc()
         if self.freshness is not None:
             out["freshness"] = self.freshness.stats()
         if self.profiler is not None:

@@ -21,6 +21,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from skyline_tpu.ops.dominance import (
@@ -468,6 +469,44 @@ def partition_summaries_device(sky, counts, active: int):
     return jnp.concatenate(
         [min_corner, witness, min_sum[:, None], max_sum[:, None]], axis=1
     )
+
+
+def prune_witness_mask(summaries: np.ndarray, alive: np.ndarray, d: int):
+    """Host-side O(P²·d) witness prefilter over the
+    ``partition_summaries_device`` output: partition B is pruned when some
+    alive partition A's witness (a REAL live point, not a bound) strictly
+    dominates B's min-corner — the witness is then <= every B point in all
+    dims and strictly below in the witnessing dim
+    (``witness_k < min_corner_B_k <= b_k``), i.e. it strictly dominates ALL
+    of B. Strict dominance is a strict partial order, so simultaneous
+    pruning is acyclic: every pruned partition's dominator chain ends at a
+    surviving partition's witness, and at least one alive partition always
+    survives — dropping pruned partitions leaves the skyline byte-identical.
+
+    Returns ``(pruned (P,) bool, witness_of (P,) int64)`` where
+    ``witness_of[b]`` is the lowest-pid alive partition whose witness first
+    certified b's prune (-1 when unpruned) — the per-partition witness
+    REASON the EXPLAIN plane records. The mask is exactly the one
+    ``PartitionSet._prune_mask`` historically computed inline; the reasons
+    are free (one extra vector write per witnessing partition).
+    """
+    P = summaries.shape[0]
+    mins = summaries[:, :d]
+    wit = summaries[:, d : 2 * d]
+    pruned = np.zeros(P, dtype=bool)
+    witness_of = np.full(P, -1, dtype=np.int64)
+    for a in np.flatnonzero(alive):
+        w = wit[a]
+        if not np.all(np.isfinite(w)):
+            continue  # empty partition: +inf witness prunes nothing
+        dom = np.all(w[None, :] <= mins, axis=1) & np.any(
+            w[None, :] < mins, axis=1
+        )
+        dom[a] = False  # a witness never beats its own min-corner
+        dom &= alive
+        witness_of[dom & ~pruned] = a
+        pruned |= dom
+    return pruned, witness_of
 
 
 # Quantized-grid flush prefilter (ISSUE 5 stage 1). GRID_BINS boundary

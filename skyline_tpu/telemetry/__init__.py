@@ -28,6 +28,7 @@ from skyline_tpu.telemetry.histogram import DEFAULT_EDGES, Histogram
 from skyline_tpu.telemetry.prometheus import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
 )
+from skyline_tpu.telemetry.explain import ExplainRecorder, QueryPlan
 from skyline_tpu.telemetry.freshness import FreshnessTracker
 from skyline_tpu.telemetry.profiler import FlightRecorder, KernelProfiler
 from skyline_tpu.telemetry.prometheus import flatten_gauges
@@ -58,6 +59,9 @@ class Telemetry:
         self.profiler = KernelProfiler(spans=self.spans)
         self.flight = FlightRecorder(env_int("SKYLINE_FLIGHT_RING", 256))
         self.slo = SloEngine(self)
+        # per-query EXPLAIN plans (ISSUE 9): the bounded ring behind
+        # GET /explain on both HTTP surfaces and /skyline?explain=1
+        self.explain = ExplainRecorder(env_int("SKYLINE_EXPLAIN_RING", 256))
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -134,12 +138,14 @@ class Telemetry:
 __all__ = [
     "Counters",
     "DEFAULT_EDGES",
+    "ExplainRecorder",
     "FlightRecorder",
     "FreshnessTracker",
     "Histogram",
     "KernelProfiler",
     "NULL_TRACER",
     "PROMETHEUS_CONTENT_TYPE",
+    "QueryPlan",
     "SloEngine",
     "SpanRecorder",
     "Telemetry",

@@ -159,6 +159,12 @@ class KernelProfiler:
         with self._lock:
             return sum(e.wall_ms for e in self._entries.values())
 
+    def snapshot_counts(self) -> dict[tuple, tuple[int, float]]:
+        """{signature: (calls, wall_ms)} — the cheap mark the EXPLAIN
+        plane diffs around one query window to attribute dispatches."""
+        with self._lock:
+            return {k: (e.calls, e.wall_ms) for k, e in self._entries.items()}
+
     def doc(self, phase_total_ms: float | None = None) -> dict:
         """The /profile document: per-signature rows sorted by wall time,
         per-variant retrace counts, and (when the caller passes the phase
@@ -222,6 +228,16 @@ class FlightRecorder:
         )
         self._lock = threading.Lock()
         self._seq = 0  # guarded-by: self._lock
+        # current query's trace_id; set/cleared only by the engine thread
+        # around trigger work, read here on the same thread — notes from
+        # other threads simply go unstamped
+        self._trace = None
+
+    def set_trace(self, trace_id: str | None) -> None:
+        """Stamp subsequent ``note`` entries with this trace_id (None to
+        stop) so /debug/flight rows join against spans and explain
+        records instead of being time-correlated by eye."""
+        self._trace = trace_id
 
     def note(self, kind: str, **fields) -> None:
         # the ring backs /debug/flight and the crash dump, so every field
@@ -231,6 +247,8 @@ class FlightRecorder:
                 fields[k] = v.hex()
             elif not isinstance(v, (str, int, float, bool, type(None))):
                 fields[k] = repr(v)
+        if self._trace is not None and "trace_id" not in fields:
+            fields["trace_id"] = self._trace
         with self._lock:
             self._seq += 1
             entry = {"seq": self._seq, "t_ms": round(time.time() * 1000.0, 1),

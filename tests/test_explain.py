@@ -1,0 +1,621 @@
+"""Per-query EXPLAIN plane (ISSUE 9): causal execution-plan records.
+
+Pins the tentpole's contract end to end: the recorder ring and its
+lookup semantics, the pure delta/diff helpers, the PartitionSet hooks on
+every merge path (cache hit / tree / tree_delta / delta / flat) with the
+forced-prune witness reasons, the engine e2e that drives one query down
+each path and checks the plan against the result, the attribution
+property (plan blocks reconcile with the telemetry counters across
+policy x distribution x d), byte-identity of answers with the plane on
+vs off, both HTTP surfaces, and the ``python -m skyline_tpu.explain``
+CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.metrics.httpstats import StatsServer
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.stream.window import prune_witness_mask
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry.explain import (
+    QueryPlan,
+    cascade_delta,
+    format_diff,
+    format_plan,
+    kernel_delta,
+    plan_diff,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# ------------------------------------------------------------ recorder ring
+
+
+def test_recorder_ring_bounds_and_lookup():
+    from skyline_tpu.telemetry.explain import ExplainRecorder
+
+    rec = ExplainRecorder(capacity=4)
+    assert rec.latest() is None and rec.by_version(1) is None
+    for i in range(6):
+        rec.add({
+            "trace_id": f"t-{i}",
+            "publish": {"version": min(i, 4)},  # 4 and 5 share version 4
+        })
+    assert len(rec) == 4
+    doc = rec.doc()
+    assert doc == {
+        "depth": 4, "recorded_total": 6, "ring_capacity": 4, "partial": True,
+    }
+    # evicted plans are gone; retained ones resolve by version and trace
+    assert rec.by_version(0) is None and rec.by_version(1) is None
+    assert rec.by_version(2)["trace_id"] == "t-2"
+    # deduped publishes map several plans to one version: newest wins
+    assert rec.by_version(4)["trace_id"] == "t-5"
+    assert rec.by_trace("t-3")["trace_id"] == "t-3"
+    assert rec.by_trace("t-0") is None
+    assert rec.latest()["trace_id"] == "t-5"
+    # add() stamps the monotonic seq + wall time
+    assert rec.latest()["seq"] == 6 and rec.latest()["t_ms"] > 0
+
+
+def test_kernel_and_cascade_delta():
+    k1 = ("merge_step", 4, 4096, "cpu", False)
+    k2 = ("sweep", 2, 1024, "cpu", True)
+    before = {k1: (2, 10.0)}
+    after = {k1: (5, 16.5), k2: (1, 30.0)}
+    rows = kernel_delta(before, after)
+    # sorted by attributed wall time, not total
+    assert [r["variant"] for r in rows] == ["sweep", "merge_step"]
+    assert rows[1] == {
+        "variant": "merge_step", "d": 4, "n_bucket": 4096, "backend": "cpu",
+        "mp": False, "calls": 3, "wall_ms": 6.5,
+    }
+    assert rows[0]["calls"] == 1 and rows[0]["mp"] is True
+    # signatures with no new calls are excluded from the window
+    assert kernel_delta(after, after) == []
+
+    c = cascade_delta(
+        {"prefilter_seen": 10, "prefilter_dropped": 4, "bf16_resolved": 1},
+        {"prefilter_seen": 25, "prefilter_dropped": 9, "bf16_resolved": 1,
+         "prefilter_enabled": True, "mixed_precision": False},
+    )
+    assert c == {
+        "prefilter_seen": 15, "prefilter_dropped": 5, "bf16_resolved": 0,
+        "prefilter_enabled": True, "mixed_precision": False,
+    }
+    # first window diffs against the empty mark: totals pass through
+    assert cascade_delta({}, {"prefilter_seen": 3})["prefilter_seen"] == 3
+
+
+def test_plan_diff_excludes_volatile_fields():
+    a = QueryPlan("t-a", "q1")
+    a.merge = {"path": "tree", "cached": False, "dirty": [0, 1]}
+    a.timing = {"local_ms": 5.0, "global_ms": 9.0}
+    a.kernels = [{"variant": "merge_step", "calls": 1, "wall_ms": 3.0}]
+    da = a.to_doc()
+    da["seq"], da["t_ms"] = 1, 100.0
+    b = QueryPlan("t-b", "q2")
+    b.merge = {"path": "tree_delta", "cached": False, "dirty": [1]}
+    b.timing = {"local_ms": 50.0, "global_ms": 90.0}
+    b.kernels = [{"variant": "merge_step", "calls": 1, "wall_ms": 30.0}]
+    db = b.to_doc()
+    db["seq"], db["t_ms"] = 2, 200.0
+    rows = plan_diff(da, db)
+    keys = [k for k, _, _ in rows]
+    # decision fields only: ids, seq/t_ms, and every *_ms excluded
+    assert "merge.path" in keys
+    assert ("merge.dirty", [0, 1], [1]) in rows
+    assert not any("wall_ms" in k or k.endswith("_ms") for k in keys)
+    assert not any(k.startswith(("trace_id", "seq", "t_ms")) for k in keys)
+    assert ("merge.path", "tree", "tree_delta") in rows
+    # identical decisions -> explicitly reported as such
+    assert "decision-identical" in format_diff(da, da)
+    assert "tree_delta" in format_diff(da, db)
+    # rendering never throws on partial plans (merge-only, no publish)
+    assert "merge path=tree" in format_plan(da)
+
+
+def test_prune_witness_mask_reasons():
+    # summaries rows: [min_corner(d) | witness(d) | min_sum | max_sum]
+    d = 2
+    summaries = np.array([
+        [1, 1, 1, 1, 2, 2],        # p0: witness (1,1) dominates p1+p3
+        [5, 5, 6, 6, 12, 12],      # p1: pruned by p0
+        [0, 9, 0, 9, 9, 9],        # p2: survives ((1,1) !<= (0,9))
+        [7, 7, 8, 8, 16, 16],      # p3: pruned by p0 (p2 checked first
+                                   #     but (0,9) does not dominate)
+        [np.inf] * 6,              # p4: empty, prunes nothing
+    ], dtype=np.float64)
+    alive = np.array([True, True, True, True, False])
+    pruned, witness_of = prune_witness_mask(summaries, alive, d)
+    assert pruned.tolist() == [False, True, False, True, False]
+    assert witness_of.tolist() == [-1, 0, -1, 0, -1]
+    # dead partitions neither prune nor get pruned: with p0 out, p3 now
+    # falls to p1's witness ((6,6) < min-corner (7,7)), p1 survives
+    alive2 = np.array([False, True, True, True, False])
+    pruned2, wo2 = prune_witness_mask(summaries, alive2, d)
+    assert pruned2.tolist() == [False, False, False, True, False]
+    assert wo2[3] == 1 and not pruned2[0]
+
+
+# ------------------------------------------- PartitionSet hooks, all paths
+
+
+def test_partitionset_plan_every_merge_path(rng, monkeypatch):
+    monkeypatch.delenv("SKYLINE_MERGE_TREE", raising=False)
+    monkeypatch.delenv("SKYLINE_MERGE_CACHE", raising=False)
+    P, d = 4, 3
+    ps = PartitionSet(P, d, buffer_size=128)
+    # partition 0 holds a universal dominator; 1..3 live far above it, so
+    # the tournament tree MUST prune them all with witness reason p0
+    ps.add_batch(0, np.array([[1.0, 1.0, 1.0]], np.float32), max_id=0,
+                 now_ms=0.0)
+    for p in range(1, P):
+        ps.add_batch(p, rng.uniform(500, 999, (20, d)).astype(np.float32),
+                     max_id=0, now_ms=0.0)
+    ps.flush_all()
+
+    plan = QueryPlan("t-1", "q0")
+    ps.set_explain(plan)
+    _, _, g, _ = ps.global_merge_stats(emit_points=True)
+    assert ps._explain is None, "launch must claim the plan one-shot"
+    assert plan.merge["path"] == "tree" and plan.merge["cached"] is False
+    assert plan.merge["dirty"] == [0, 1, 2, 3] and plan.merge["clean"] == []
+    assert len(plan.merge["epoch_key"]) > 0
+    assert plan.merge["skyline_size"] == int(g) == 1
+    wit = {e["partition"]: e["witness"] for e in plan.tree["pruned"]}
+    assert wit == {1: 0, 2: 0, 3: 0}
+    assert plan.tree["partitions_pruned"] == 3
+
+    # repeat trigger: epoch cache answers, no kernels
+    plan2 = QueryPlan("t-2", "q1")
+    ps.set_explain(plan2)
+    ps.global_merge_stats(emit_points=True)
+    assert plan2.merge["path"] == "cache_hit" and plan2.merge["cached"]
+    # on a pure hit every populated partition serves from cache unchanged
+    assert plan2.merge["dirty"] == []
+    assert plan2.merge["clean"] == [0, 1, 2, 3]
+    assert plan2.merge["dirty_fraction"] == 0.0
+    assert plan2.merge["epoch_key"] == plan.merge["epoch_key"]
+
+    # dirty one partition of four -> tree_delta with the dirty set named
+    ps.add_batch(2, rng.uniform(500, 999, (8, d)).astype(np.float32),
+                 max_id=1, now_ms=0.0)
+    ps.flush_all()
+    plan3 = QueryPlan("t-3", "q2")
+    ps.set_explain(plan3)
+    ps.global_merge_stats(emit_points=True)
+    assert plan3.merge["path"] == "tree_delta"
+    assert plan3.merge["dirty"] == [2]
+    assert sorted(plan3.merge["clean"]) == [0, 1, 3]
+    assert plan3.merge["dirty_fraction"] == pytest.approx(0.25)
+    assert plan3.merge["delta_rows"] >= 1
+    assert plan3.merge["epoch_key"] != plan.merge["epoch_key"]
+
+    # tree off: the same dirty-subset decision reads "delta"
+    monkeypatch.setenv("SKYLINE_MERGE_TREE", "0")
+    ps.add_batch(1, rng.uniform(500, 999, (8, d)).astype(np.float32),
+                 max_id=2, now_ms=0.0)
+    ps.flush_all()
+    plan4 = QueryPlan("t-4", "q3")
+    ps.set_explain(plan4)
+    ps.global_merge_stats(emit_points=True)
+    assert plan4.merge["path"] == "delta" and plan4.tree is None
+
+    # cache plane off entirely: full flat recompute, everything dirty
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "0")
+    plan5 = QueryPlan("t-5", "q4")
+    ps.set_explain(plan5)
+    ps.global_merge_stats(emit_points=True)
+    assert plan5.merge["path"] == "flat"
+    assert plan5.merge["dirty"] == [0, 1, 2, 3]
+    assert plan5.merge["dirty_fraction"] is None  # stale-value guard
+
+    # set_explain(None) clears a parked plan (engine trigger-abort path)
+    ps.set_explain(QueryPlan("t-6", "q5"))
+    ps.set_explain(None)
+    assert ps._explain is None
+
+
+# --------------------------------------------------------------- engine e2e
+
+
+def _ingest(eng, ids, x):
+    eng.process_records(np.asarray(ids, dtype=np.int64), x)
+
+
+def test_engine_e2e_plan_per_merge_path(monkeypatch):
+    """Acceptance: force one query through each merge path and check the
+    plan's path, pruned set, cascade drops, dispatch signatures, and
+    publish watermark against the engine's own result/counters."""
+    monkeypatch.delenv("SKYLINE_EXPLAIN", raising=False)
+    from skyline_tpu.serve import SnapshotStore
+
+    tel = Telemetry()
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, dims=4, domain_max=1000.0,
+                     algo="mr-dim", emit_skyline_points=True),
+        telemetry=tel,
+    )
+    eng.attach_snapshots(SnapshotStore())
+    rng = np.random.default_rng(7)
+    x = rng.uniform(1, 999, size=(3000, 4)).astype(np.float32)
+    _ingest(eng, np.arange(x.shape[0]), x)
+
+    P = eng.config.num_partitions
+    eng.process_trigger("q1,0")
+    (r1,) = eng.poll_results()
+    p1 = tel.explain.latest()
+    assert p1["trace_id"] == r1["trace_id"]
+    assert p1["merge"]["path"] == "tree" and not p1["merge"]["cached"]
+    assert p1["merge"]["dirty"] == list(range(P))
+    assert p1["merge"]["skyline_size"] == r1["skyline_size"]
+    assert p1["tree"]["levels"] >= 1 and p1["tree"]["considered"] >= 1
+    # cascade attribution covers this query's ingest window: every row of
+    # the stream went through the d>2 grid prefilter
+    assert p1["cascade"]["prefilter_enabled"] is True
+    assert p1["cascade"]["prefilter_seen"] > 0
+    assert p1["cascade"]["prefilter_dropped"] >= 0
+    # dispatch signatures with attributed wall time
+    assert p1["kernels"], "window must attribute at least one kernel"
+    for k in p1["kernels"]:
+        assert set(k) == {"variant", "d", "n_bucket", "backend", "mp",
+                          "calls", "wall_ms"}
+        assert k["calls"] >= 1 and k["wall_ms"] >= 0
+    assert any(k["d"] == 4 for k in p1["kernels"])
+    assert p1["publish"]["version"] == 1
+    assert p1["publish"]["deduped"] is False
+    assert "event_wm_ms" in p1["publish"]
+    assert p1["timing"]["latency_ms"] >= p1["timing"]["global_ms"]
+
+    # repeat trigger, no new data: cache hit, publish dedupes to v1
+    eng.process_trigger("q2,0")
+    (r2,) = eng.poll_results()
+    p2 = tel.explain.latest()
+    assert p2["merge"]["path"] == "cache_hit"
+    assert p2["merge"]["dirty"] == []
+    assert p2["publish"] == {"version": 1, "deduped": True,
+                             "event_wm_ms": p1["publish"]["event_wm_ms"]}
+    # the cache-hit window launched no merge kernels
+    assert not any("merge" in k["variant"] for k in p2["kernels"])
+
+    # mr-dim range-partitions on dim 0: rows with v0 below the first
+    # range boundary all land on partition 0 -> small dirty fraction ->
+    # delta path
+    small = rng.uniform(1, 999, size=(64, 4)).astype(np.float32)
+    small[:, 0] = rng.uniform(1, 0.8 * 1000.0 / P, size=64)
+    _ingest(eng, np.arange(3000, 3064), small)
+    eng.process_trigger("q3,0")
+    (r3,) = eng.poll_results()
+    p3 = tel.explain.latest()
+    assert p3["merge"]["path"] == "tree_delta"
+    assert p3["merge"]["dirty"] == [0]
+    assert sorted(p3["merge"]["clean"]) == list(range(1, P))
+    assert p3["merge"]["delta_rows"] >= 1
+    assert p3["merge"]["skyline_size"] == r3["skyline_size"]
+    assert p3["publish"]["version"] >= 1
+    assert p3["cascade"]["prefilter_seen"] == 64  # just this window
+
+    # plan plumbing: ring, counter, /stats block, explain child spans
+    assert tel.counters.get("explain.records") == 3
+    assert eng.stats()["explain"]["recorded_total"] == 3
+    names = [s["name"] for s in tel.spans.snapshot()]
+    assert "explain/tree" in names and "explain/cache_hit" in names
+    assert "explain/tree_delta" in names
+    for s in tel.spans.snapshot():
+        if s["name"] == "explain/tree":
+            assert s["trace_id"] == r1["trace_id"]
+    # flight-ring rows of the traced queries carry their trace_id
+    flight = [e for e in tel.flight.snapshot() if "trace_id" in e]
+    assert flight and {e["trace_id"] for e in flight} <= {
+        r1["trace_id"], r2["trace_id"], r3["trace_id"],
+    }
+
+
+def test_engine_host_path_plan(monkeypatch):
+    """Per-partition host merges (pending completeness barriers) never
+    touch the device hooks; the finalizer stamps the fallback 'host' path
+    so every answer still gets a plan."""
+    monkeypatch.delenv("SKYLINE_EXPLAIN", raising=False)
+    tel = Telemetry()
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, dims=3, domain_max=1000.0),
+        telemetry=tel,
+    )
+    rng = np.random.default_rng(3)
+    _ingest(eng, np.arange(800),
+            rng.uniform(1, 999, size=(800, 3)).astype(np.float32))
+    # require id 801: every partition's completeness barrier is pending,
+    # so each answers host-side as its next ingest arrives
+    eng.process_trigger("q1,801")
+    assert eng.poll_results() == []
+    _ingest(eng, np.arange(800, 1200),
+            rng.uniform(1, 999, size=(400, 3)).astype(np.float32))
+    (r,) = eng.poll_results()
+    plan = tel.explain.latest()
+    assert plan["merge"] == {"path": "host", "cached": False,
+                             "skyline_size": r["skyline_size"]}
+    assert plan["tree"] is None
+    assert plan["timing"]["total_ms"] >= 0
+
+
+def test_engine_explain_off_records_nothing(monkeypatch):
+    monkeypatch.setenv("SKYLINE_EXPLAIN", "0")
+    tel = Telemetry()
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, dims=3, domain_max=1000.0),
+        telemetry=tel,
+    )
+    rng = np.random.default_rng(3)
+    _ingest(eng, np.arange(500),
+            rng.uniform(1, 999, size=(500, 3)).astype(np.float32))
+    eng.process_trigger("q1,0")
+    (r,) = eng.poll_results()
+    assert r["skyline_size"] > 0
+    assert len(tel.explain) == 0
+    assert tel.counters.get("explain.records") == 0
+    assert "explain" not in eng.stats()
+
+
+# ------------------------------------------------- attribution property
+
+
+GRID = [
+    ("incremental", "uniform", 3),
+    ("incremental", "anti", 2),   # d=2: sweep path, prefilter/tree off
+    ("lazy", "uniform", 4),
+    ("lazy", "anti", 4),
+]
+
+
+def _make_stream(dist, d, n, seed):
+    rng = np.random.default_rng(seed)
+    if dist == "uniform":
+        x = rng.uniform(1, 999, (n, d))
+    else:
+        base = rng.uniform(1, 999, (n, 1))
+        x = np.clip(np.abs((999 - base) + rng.normal(0, 60, (n, d))), 1, 999)
+    return rng, x.astype(np.float32)
+
+
+def _drive(policy, dist, d, *, explain):
+    from skyline_tpu.serve import SnapshotStore
+
+    tel = Telemetry()
+    eng = SkylineEngine(
+        EngineConfig(parallelism=4, dims=d, domain_max=1000.0,
+                     buffer_size=256, flush_policy=policy,
+                     emit_skyline_points=True),
+        telemetry=tel,
+    )
+    eng.attach_snapshots(SnapshotStore())
+    assert eng._explain_on is explain
+    rng, x = _make_stream(dist, d, 1200, seed=11)
+    results = []
+    pos = 0
+    for i, stop in enumerate((400, 900, 1200)):
+        while pos < stop:
+            end = min(pos + int(rng.integers(50, 300)), stop)
+            _ingest(eng, np.arange(pos, end), x[pos:end])
+            pos = end
+        eng.process_trigger(f"q{i},0")
+        results.extend(eng.poll_results())
+    eng.process_trigger("q3,0")  # repeat: cache-hit leg
+    results.extend(eng.poll_results())
+    return tel, eng, results
+
+
+@pytest.mark.parametrize("policy,dist,d", GRID)
+def test_property_plans_reconcile_with_counters(policy, dist, d,
+                                                monkeypatch):
+    """Plan attribution must agree with the aggregate telemetry the plane
+    claims to explain: per-path counts, pruned-partition totals, and
+    flush-cascade totals all reconcile; answers are byte-identical with
+    the plane off."""
+    monkeypatch.delenv("SKYLINE_EXPLAIN", raising=False)
+    tel, eng, results = _drive(policy, dist, d, explain=True)
+    plans = tel.explain.snapshot()
+    assert len(plans) == len(results) == 4
+    assert [p["trace_id"] for p in plans] == [
+        r["trace_id"] for r in results
+    ]
+    counters = tel.counters.snapshot()
+
+    # merge-path attribution == cache-plane counters
+    hits = [p for p in plans if p["merge"]["path"] == "cache_hit"]
+    assert len(hits) == counters.get("merge.cache_hit", 0) >= 1
+    for p in hits:
+        assert p["publish"]["deduped"] is True
+    # every plan's skyline size matches its emitted result
+    for p, r in zip(plans, results):
+        assert p["merge"]["skyline_size"] == r["skyline_size"]
+        assert p["publish"]["version"] <= len(results)
+
+    # pruned-partition totals == the merge.partitions_pruned counter
+    pruned_total = sum(
+        (p["tree"] or {}).get("partitions_pruned", 0) for p in plans
+    )
+    assert pruned_total == counters.get("merge.partitions_pruned", 0)
+
+    # cascade windows tile the run: per-plan deltas sum to the set totals
+    cascade = eng.pset.flush_cascade_stats()
+    for key, total in (
+        ("prefilter_seen", cascade["prefilter_seen"]),
+        ("prefilter_dropped", cascade["prefilter_dropped"]),
+        ("bf16_resolved", cascade["bf16_resolved"]),
+    ):
+        assert sum(p["cascade"][key] for p in plans) == total, key
+    if d == 2:
+        assert cascade["prefilter_enabled"] is False
+        assert all(p["tree"] is None for p in plans)
+
+    # byte-identity: the identical run with the plane off emits the same
+    # answers, point bytes included
+    monkeypatch.setenv("SKYLINE_EXPLAIN", "0")
+    _, _, results_off = _drive(policy, dist, d, explain=False)
+    assert len(results_off) == len(results)
+    for a, b in zip(results, results_off):
+        assert a["skyline_size"] == b["skyline_size"]
+        assert np.asarray(a["skyline_points"]).tobytes() == \
+            np.asarray(b["skyline_points"]).tobytes()
+
+
+# ------------------------------------------------------------ HTTP surfaces
+
+
+def _mk_plan_doc(version=7, trace="t-x", path="flat"):
+    plan = QueryPlan(trace, "q0")
+    plan.merge = {"path": path, "cached": False, "dirty": [0], "clean": []}
+    plan.publish = {"version": version, "deduped": False,
+                    "event_wm_ms": None}
+    return plan.to_doc()
+
+
+def test_statsserver_explain_endpoint():
+    tel = Telemetry()
+    tel.explain.add(_mk_plan_doc(version=7, trace="t-x"))
+    srv = StatsServer(lambda: {}, port=0, telemetry=tel)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _get(f"{base}/explain")
+        assert status == 200 and json.loads(body)["trace_id"] == "t-x"
+        status, body = _get(f"{base}/explain?version=7")
+        assert status == 200
+        status, body = _get(f"{base}/explain?trace_id=t-x")
+        assert status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/explain?version=99")
+        assert ei.value.code == 404
+        missing = json.load(ei.value)
+        assert missing["ring"]["recorded_total"] == 1  # evicted vs never
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/explain?version=abc")
+        assert ei.value.code == 400
+        # the query string must not break sibling exact-path routes
+        status, _ = _get(f"{base}/healthz?x=1")
+        assert status == 200
+    finally:
+        srv.close()
+    # no telemetry hub: /explain answers 404, not 500
+    srv = StatsServer(lambda: {}, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://127.0.0.1:{srv.port}/explain")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+@pytest.fixture
+def explain_worker(monkeypatch):
+    monkeypatch.delenv("SKYLINE_EXPLAIN", raising=False)
+    from skyline_tpu.bridge import MemoryBus, SkylineWorker
+    from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+
+    bus = MemoryBus()
+    worker = SkylineWorker(
+        bus, EngineConfig(parallelism=2, dims=3), stats_port=0,
+        serve_port=0,
+    )
+    rng = np.random.default_rng(5)
+    x = rng.uniform(1, 999, size=(1500, 3)).astype(np.float32)
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, row) for i, row in enumerate(x)],
+    )
+    bus.produce("queries", format_trigger(0, 0))
+    while worker.step() > 0:
+        pass
+    try:
+        yield worker
+    finally:
+        worker.close()
+
+
+def test_serve_plane_inline_explain_and_byte_stability(explain_worker):
+    base = f"http://127.0.0.1:{explain_worker.serve_server.port}"
+    _, plain1 = _get(f"{base}/skyline")
+    status, ebody = _get(f"{base}/skyline?explain=1")
+    assert status == 200
+    edoc = json.loads(ebody)
+    plan = edoc["explain"]
+    assert plan["merge"]["path"] and plan["publish"]["version"] == 1
+    assert plan["publish"]["event_wm_ms"] is not None  # real watermark
+    # plain reads stay byte-stable around an explain read: same cached
+    # prefix, explain only ever rides the volatile tail
+    _, plain2 = _get(f"{base}/skyline")
+    d1, d2 = json.loads(plain1), json.loads(plain2)
+    assert "explain" not in d1 and "explain" not in d2
+    assert plain1.split(b', "age_ms"')[0] == plain2.split(b', "age_ms"')[0]
+    assert d1["digest"] == d2["digest"] == edoc["digest"]
+    # the serve plane's own /explain endpoint answers too
+    status, body = _get(f"{base}/explain?version=1")
+    assert status == 200
+    assert json.loads(body)["trace_id"] == plan["trace_id"]
+    try:
+        _get(f"{base}/explain?version=999")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404 and "ring" in json.load(e)
+
+
+def test_worker_metrics_export_explain_counter(explain_worker, prom_parse):
+    base = f"http://127.0.0.1:{explain_worker.stats_server.port}"
+    _, body = _get(f"{base}/metrics")
+    series = prom_parse(body.decode())
+    series.pop("__types__")
+    assert series["skyline_explain_records_total"][0][1] >= 1.0
+    assert series["skyline_explain_depth"] == [({}, 1.0)]
+    stats = explain_worker.stats()
+    assert stats["explain"]["recorded_total"] == 1
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _run_cli(args, stdin=None):
+    return subprocess.run(
+        [sys.executable, "-m", "skyline_tpu.explain"] + args,
+        capture_output=True, text=True, timeout=60, input=stdin,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_pretty_print_diff_and_errors(tmp_path):
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps(_mk_plan_doc(version=1, path="tree")))
+    pb.write_text(json.dumps(_mk_plan_doc(version=2, path="cache_hit")))
+    r = _run_cli([str(pa)])
+    assert r.returncode == 0 and "merge path=tree" in r.stdout
+    r = _run_cli([str(pa), "--json"])
+    assert json.loads(r.stdout)["merge"]["path"] == "tree"
+    r = _run_cli([str(pa), str(pb)])
+    assert r.returncode == 0 and "'tree' -> 'cache_hit'" in r.stdout
+    r = _run_cli([str(pa), str(pb), "--json"])
+    rows = json.loads(r.stdout)
+    assert {"field": "merge.path", "a": "tree", "b": "cache_hit"} in rows
+    # stdin + wrapper unwrap: a /skyline?explain=1 body is accepted
+    wrapper = json.dumps({"version": 1, "explain": _mk_plan_doc()})
+    r = _run_cli(["-"], stdin=wrapper)
+    assert r.returncode == 0 and "merge path=flat" in r.stdout
+    # a JSON doc with no plan inside is a clean error, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"hello": 1}))
+    r = _run_cli([str(bad)])
+    assert r.returncode != 0 and "no plan found" in r.stderr
