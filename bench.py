@@ -322,12 +322,20 @@ def serve_leg(d: int, algo: str) -> dict:
         explain["ring_add_us"] = round(
             (time.perf_counter() - t0) / reps * 1e6, 2
         )
+    # audit-plane stamp (ISSUE 10): shadow-verification verdict over this
+    # run's published answers — scripts/bench_compare.py fails the gate on
+    # ANY divergence; the on/off overhead lives in benchmarks/audit.py ->
+    # audit_ab.json
+    audit = dict(st.get("audit", {"skipped": True}))
+    audit.pop("last_check", None)  # verbatim ring records stay off the
+    audit.pop("last_divergence", None)  # artifact; totals gate the compare
     return {
         # end-to-end lineage + per-kernel registry from the same run the
         # reads above hit; child_main lifts these to top-level artifact keys
         "freshness": st.get("freshness", {}),
         "kernel_profile": st.get("kernel_profile", {}),
         "explain": explain,
+        "audit": audit,
         "read_p50_ms": round(read_pcts["p50"], 2),
         "read_p99_ms": round(read_pcts["p99"], 2),
         "reads_ok": sum(1 for c in codes if c == 200),
@@ -460,6 +468,7 @@ def child_main(backend: str) -> None:
     freshness = serve.pop("freshness", {"skipped": True})
     kernel_profile = serve.pop("kernel_profile", {"skipped": True})
     explain = serve.pop("explain", {"skipped": True})
+    audit = serve.pop("audit", {"skipped": True})
     try:
         merge_cache, merge_tree, flush_cascade = merge_cache_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
@@ -507,6 +516,7 @@ def child_main(backend: str) -> None:
                 "freshness": freshness,
                 "kernel_profile": kernel_profile,
                 "explain": explain,
+                "audit": audit,
                 "analysis": analysis,
                 "baseline_anchor": "reference 4D/1M ~1400 tuples/s (d=8 never completed)",
             }

@@ -12,6 +12,8 @@ non-zero when any regresses past ``--threshold`` (default 25%):
   serve.read_p99_ms      serve read p99    higher is a regression
   merge_cache.hit_rate   merge-cache leg   lower is a regression
   flush_cascade.prefilter_drop_fraction    lower is a regression
+  audit.divergence_total shadow checks     ABSOLUTE: any divergence in
+                                           the NEW artifact fails
 
 A metric missing from either artifact (e.g. the serve leg was skipped) is
 reported as ``skipped`` and never fails the gate. Runs on different
@@ -135,6 +137,25 @@ def compare(old: dict, new: dict, threshold: float) -> tuple[list[str], bool]:
             f"({delta:+.1%})  {arrow}"
         )
         regressed = regressed or bad
+    # audit plane (ISSUE 10): a shadow-verification divergence in the NEW
+    # run is a correctness regression outright — absolute, no threshold,
+    # no ratio against OLD (one lying answer is one too many). An absent
+    # block (older artifact, auditor off) skips, never fails.
+    div = dig(new, ("audit", "divergence_total"))
+    if div is None:
+        lines.append(f"  {'audit.divergence_total':<24} skipped (absent)")
+    elif div > 0:
+        lines.append(
+            f"  {'audit.divergence_total':<24} {div:>12.0f}  "
+            "REGRESSION (any divergence fails)"
+        )
+        regressed = True
+    else:
+        checks = dig(new, ("audit", "checks_total")) or 0.0
+        lines.append(
+            f"  {'audit.divergence_total':<24} {0:>12.2f}  "
+            f"(over {checks:.0f} check(s))  ok"
+        )
     return lines, regressed
 
 

@@ -16,8 +16,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 CKPT_DIR="$(mktemp -d)"
-export CKPT_DIR
-trap 'rm -rf "$CKPT_DIR"' EXIT
+AUDIT_DIR="$(mktemp -d)"
+export CKPT_DIR AUDIT_DIR
+trap 'rm -rf "$CKPT_DIR" "$AUDIT_DIR"' EXIT
 
 JAX_PLATFORMS=cpu python - <<'EOF'
 import hashlib
@@ -126,4 +127,65 @@ print(f"[chaos-smoke] counters ok: restarts={counts['resilience.restarts']} "
       f"wal.replayed={counts['wal.replayed']} "
       f"checkpoint.saved={counts['checkpoint.saved']}")
 print("[chaos-smoke] PASS")
+EOF
+
+# audit divergence drill (ISSUE 10, RUNBOOK §2l): corrupt one byte of a
+# published snapshot via the corrupt@audit.corrupt fault point and prove
+# the shadow-verification plane catches it — divergence counter moves, a
+# complete repro bundle freezes, and the offline replay reproduces the
+# diff while acquitting the engine (the drill lied at the snapshot layer)
+JAX_PLATFORMS=cpu SKYLINE_AUDIT_DIR="$AUDIT_DIR" python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from skyline_tpu.resilience.faults import FaultPlan, clear, install_plan
+from skyline_tpu.serve import SnapshotStore
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.workload.generators import anti_correlated
+
+install_plan(FaultPlan.parse("corrupt@audit.corrupt:1"))
+try:
+    tel = Telemetry()
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, dims=3, domain_max=10000.0,
+                     emit_skyline_points=True),
+        telemetry=tel,
+    )
+    eng.attach_snapshots(SnapshotStore())
+    rng = np.random.default_rng(29)
+    x = anti_correlated(rng, 1500, 3, 0, 10000)
+    eng.process_records(np.arange(len(x)), x, now_ms=0.0)
+    eng.process_trigger("q0,0", now_ms=1.0)
+    eng.poll_results()
+finally:
+    clear()
+
+counts = tel.counters.snapshot()
+assert counts.get("audit.checks") == 1, counts
+assert counts.get("audit.divergence") == 1, counts
+doc = tel.audit.doc()
+assert doc["ok"] is False and doc["bundles"], doc
+bundle = doc["bundles"][0]
+for fname in ("manifest.json", "checkpoint.npz", "published.npy",
+              "oracle.npy", "explain.json"):
+    assert os.path.exists(os.path.join(bundle, fname)), (bundle, fname)
+# the divergence joined the flight ring under the snapshot's trace_id
+notes = [e for e in tel.flight.snapshot() if e["kind"] == "audit.divergence"]
+assert notes and notes[-1]["trace_id"] == doc["last_divergence"]["trace_id"]
+
+r = subprocess.run(
+    [sys.executable, "-m", "skyline_tpu.audit", "replay", bundle, "--json"],
+    capture_output=True, text=True, timeout=300,
+)
+assert r.returncode == 0, (r.returncode, r.stderr)
+verdict = json.loads(r.stdout)
+assert verdict["reproduced"] is True, verdict
+assert verdict["engine_diverges"] is False, verdict
+print(f"[chaos-smoke] audit drill ok: divergence detected, bundle at "
+      f"{bundle}, replay reproduced the diff (engine acquitted)")
 EOF

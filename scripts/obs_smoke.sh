@@ -143,7 +143,11 @@ try:
                  "skyline_compile_cache_misses_total",
                  # EXPLAIN plane (ISSUE 9): per-query plans recorded
                  # (registered at engine ctor, so exported even at zero)
-                 "skyline_explain_records_total"):
+                 "skyline_explain_records_total",
+                 # audit plane (ISSUE 10): shadow-verification totals
+                 # (registered at engine ctor, so exported even at zero)
+                 "skyline_audit_checks_total",
+                 "skyline_audit_divergence_total"):
         assert want in body, f"{want} missing from exposition"
     for stage in ("ingest", "flush", "merge", "publish", "read"):
         assert f'stage="{stage}"' in body, \
@@ -178,7 +182,8 @@ try:
         slo = json.load(r)
     assert slo["ok"] is True, slo
     assert set(slo["slos"]) == {"read_p99", "freshness_p99",
-                                "shed_fraction", "restart_rate"}, slo
+                                "shed_fraction", "restart_rate",
+                                "audit_divergence"}, slo
     for name, s in slo["slos"].items():
         assert {"fast", "slow"} <= set(s["windows"]), (name, s)
         assert s["breach"] is False, (name, s)
@@ -208,6 +213,34 @@ try:
     print(f"[obs-smoke] /explain ok: {stats['explain']['recorded_total']} "
           f"plan(s), latest path={plan['merge']['path']} "
           f"(v{plan['publish']['version']}, deduped)")
+
+    # audit plane (ISSUE 10): every answer above was shadow-verified
+    # against the host oracle at publish time (sample defaults to 1.0),
+    # and one canary sweep proves every merge decision path — with zero
+    # divergence across the lot
+    worker.engine.auditor.run_canaries()
+    for base in (stats_base, serve_base):
+        with urllib.request.urlopen(f"{base}/audit", timeout=5) as r:
+            audit = json.load(r)
+        assert audit["ok"] is True, audit
+        assert audit["checks_total"] >= 2 + 5, audit  # organic + canaries
+        assert audit["divergence_total"] == 0, audit
+        assert set(audit["canaries"]) == {
+            "flat", "tree", "cache_hit", "tree_delta", "host",
+        }, audit["canaries"]
+        assert all(c["last_ok"] for c in audit["canaries"].values()), audit
+        # the trace join back into /explain and /trace: an organic check
+        # answers under its audited snapshot's trace_id (the dedupe kept
+        # the FIRST query's snapshot, so join on the ring's own record)
+        organic = [c for c in worker.telemetry.audit.snapshot()
+                   if c["kind"] == "organic"]
+        assert organic and organic[-1]["trace_id"], organic
+        with urllib.request.urlopen(
+            f"{base}/audit?trace_id={organic[-1]['trace_id']}", timeout=5
+        ) as r:
+            assert json.load(r)["ok"] is True
+    print(f"[obs-smoke] /audit ok: {audit['checks_total']} check(s), "
+          f"0 divergence, canary paths {sorted(audit['canaries'])}")
 
     # flight recorder: flushes + merges above left dispatch decisions in
     # the ring

@@ -311,6 +311,28 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SLO_RESTARTS_PER_HOUR", "float", 6.0,
        "SLO target: supervised-restart rate ceiling", "telemetry/slo",
        runbook="§2j"),
+    _k("SKYLINE_AUDIT", "bool", True,
+       "online audit plane: sampled shadow verification of published "
+       "snapshots against the host oracle, divergence repro bundles, and "
+       "correctness canaries behind GET /audit", "audit", runbook="§2l"),
+    _k("SKYLINE_AUDIT_SAMPLE", "float", 1.0,
+       "fraction of published snapshots shadow-verified (deterministic "
+       "accumulator, not random; 0 disables organic checks)", "audit",
+       runbook="§2l"),
+    _k("SKYLINE_AUDIT_RING", "int", 256,
+       "audit check-record ring capacity (last N verdicts on /audit)",
+       "audit", runbook="§2l"),
+    _k("SKYLINE_AUDIT_DIR", "str", "artifacts/audit",
+       "divergence repro-bundle directory (checkpoint + WAL slice + "
+       "EXPLAIN plan + knob snapshot + both skylines)", "audit",
+       runbook="§2l"),
+    _k("SKYLINE_AUDIT_CANARY_S", "float", 300.0,
+       "seconds between synthetic known-answer canary sweeps over every "
+       "merge path while the worker is idle (0 = off)", "audit",
+       runbook="§2l"),
+    _k("SKYLINE_SLO_AUDIT_DIVERGENCE", "float", 0.0001,
+       "SLO target: max fraction of audited snapshots diverging from the "
+       "host oracle", "telemetry/slo", runbook="§2l"),
     # -- bench harness (bench.py) ------------------------------------------
     _k("BENCH_N", "int", None,
        "window rows (default 1M on TPU, BENCH_CPU_N on the fallback)",

@@ -238,6 +238,12 @@ class SkylineWorker:
             # logs a bogus everything-entered delta
             if self._snap_store is not None:
                 self._snap_store.on_publish(self._wal_on_publish)
+            # divergence repro bundles freeze the live WAL segment slice;
+            # without resilience the auditor's wal_dir stays None and
+            # bundles simply omit the wal/ directory
+            auditor = getattr(self.engine, "auditor", None)
+            if auditor is not None:
+                auditor.wal_dir = self._wal_dir
             self._wal.append(
                 {
                     "type": "start",
@@ -855,6 +861,12 @@ class SkylineWorker:
                     idle_since = now
                 elif stop_after_idle_s is not None and now - idle_since > stop_after_idle_s:
                     return
+                # idle ticks drive the correctness canaries: with no
+                # organic traffic to audit, the synthetic known-answer
+                # micro-states keep every merge path under verification
+                auditor = getattr(self.engine, "auditor", None)
+                if auditor is not None:
+                    auditor.maybe_canary()
                 time.sleep(idle_sleep_s)
             else:
                 idle_since = None

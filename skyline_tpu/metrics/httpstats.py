@@ -25,6 +25,9 @@ Endpoints:
   GET /debug/flight  bounded flight-recorder ring of dispatch decisions
   GET /explain  one per-query EXPLAIN plan from the hub ring
                 (?version=N | ?trace_id=... | latest)
+  GET /audit    audit-plane verdict: shadow-verification totals, canary
+                path coverage, divergence bundles
+                (?trace_id=... for one check record)
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -195,6 +198,12 @@ class StatsServer:
                     else:
                         code, doc = outer._explain_doc(qs)
                         handler._reply(code, doc)
+                elif path == "/audit":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        code, doc = outer._audit_doc(qs)
+                        handler._reply(code, doc)
                 elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
@@ -244,6 +253,19 @@ class StatsServer:
         if plan is None:
             return 404, {"error": "no matching plan", "ring": rec.doc()}
         return 200, plan
+
+    def _audit_doc(self, qs: str) -> tuple[int, dict]:
+        """Resolve an /audit request against the hub's verdict ring:
+        ``trace_id=...`` → the check record for that snapshot's trace
+        (the /explain and /trace join), no params → the full verdict."""
+        params = {k: v[-1] for k, v in parse_qs(qs).items()}
+        rec = self.telemetry.audit
+        if params.get("trace_id"):
+            check = rec.by_trace(params["trace_id"])
+            if check is None:
+                return 404, {"error": "no matching check", "ring": rec.doc()}
+            return 200, check
+        return 200, rec.doc()
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

@@ -200,6 +200,21 @@ def explain_enabled() -> bool:
     return env_bool("SKYLINE_EXPLAIN", True)
 
 
+def audit_enabled() -> bool:
+    """``SKYLINE_AUDIT`` gates the online audit plane (``audit/``): a
+    sampled fraction of published snapshots (``SKYLINE_AUDIT_SAMPLE``)
+    is recomputed from partition state through the independent host
+    oracle and compared byte-for-byte, with divergences frozen into
+    repro bundles under ``SKYLINE_AUDIT_DIR``. Checks run host-side
+    after the answer is already published — nothing enters jit and the
+    hot path only pays a sampling-accumulator update — so default ON;
+    set ``0`` for the unaudited baseline (``benchmarks/audit.py`` A/B).
+    Read lazily at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_AUDIT", True)
+
+
 def profile_cost_enabled() -> bool:
     """``SKYLINE_PROFILE_COST`` additionally captures XLA
     ``cost_analysis()`` FLOPs/bytes per dispatch signature via a one-shot

@@ -32,10 +32,14 @@ KILL_POINTS = frozenset(
         "checkpoint.pre_replace",  # utils/checkpoint.py before os.replace
         "snapshot.publish",  # serve/snapshot.py publish entry
         "kafka.poll",  # bridge/worker.py step() poll entry
+        "audit.corrupt",  # serve/snapshot.py publish body byte-flip
     )
 )
 
-_ACTIONS = ("crash", "exit")
+# "corrupt" does not kill the process: the instrumented site polls
+# fault_fired() and mutates its own data when the clause comes up — used
+# by the audit divergence drill to flip one byte in a published snapshot.
+_ACTIONS = ("crash", "exit", "corrupt")
 
 
 class InjectedCrash(BaseException):
@@ -95,15 +99,22 @@ class FaultPlan:
             raise ValueError(f"empty fault plan {spec!r}")
         return cls(clauses)
 
-    def hit(self, point: str) -> None:
+    def hit(self, point: str) -> bool:
+        """Count a hit; crash/exit clauses never return, a fired corrupt
+        clause returns True so the site can mutate its own data."""
         n = self.hits.get(point, 0) + 1
         self.hits[point] = n
+        fired = False
         for c in self.clauses:
             if c.point == point and not c.fired and c.nth == n:
                 c.fired = True
+                if c.action == "corrupt":
+                    fired = True
+                    continue
                 if c.action == "exit":
                     os._exit(86)  # a hard process death, no unwinding
                 raise InjectedCrash(f"injected crash at {point} (hit {n})")
+        return fired
 
     def exhausted(self) -> bool:
         return all(c.fired for c in self.clauses)
@@ -121,6 +132,13 @@ def fault_point(point: str) -> None:
     plan = _PLAN
     if plan is not None:
         plan.hit(point)
+
+
+def fault_fired(point: str) -> bool:
+    """Like fault_point but for data-mutating clauses: returns True when a
+    ``corrupt@<point>`` clause fires on this hit. Same no-plan fast path."""
+    plan = _PLAN
+    return plan.hit(point) if plan is not None else False
 
 
 def install_plan(plan: FaultPlan | None) -> None:

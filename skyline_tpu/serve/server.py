@@ -28,9 +28,13 @@ operator observability; this one serves the skyline itself. Endpoints:
                   N-bucket, backend, mp → calls / wall / EMA / retrace
                   canary, optional cost_analysis columns).
   GET  /slo       declarative SLO table with multi-window burn rates
-                  (read p99, freshness lag p99, shed fraction, restarts).
+                  (read p99, freshness lag p99, shed fraction, restarts,
+                  audit divergence).
   GET  /debug/flight  the flight recorder — last N engine decisions
                   (dispatch / cascade / prune / cache), crash black box.
+  GET  /audit     audit-plane verdict: shadow-verification totals, canary
+                  path coverage, divergence bundles (``?trace_id=`` joins
+                  one check back to /explain and /trace).
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -330,6 +334,8 @@ class SkylineServer:
             await self._reply(writer, 200, self.telemetry.flight.doc())
         elif path == "/explain" and method == "GET":
             await self._explain(writer, params)
+        elif path == "/audit" and method == "GET":
+            await self._audit(writer, params)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -525,6 +531,25 @@ class SkylineServer:
             )
             return
         await self._reply(writer, 200, plan)
+
+    async def _audit(self, writer, params):
+        """The audit-plane verdict from the hub's check ring: totals,
+        canary path coverage, divergence bundles. ``?trace_id=`` returns
+        the single check record for that snapshot's trace — the join back
+        into /explain and /trace."""
+        rec = self.telemetry.audit
+        trace = params.get("trace_id")
+        if trace:
+            check = rec.by_trace(trace)
+            if check is None:
+                await self._reply(
+                    writer, 404,
+                    {"error": "no matching check", "ring": rec.doc()},
+                )
+                return
+            await self._reply(writer, 200, check)
+            return
+        await self._reply(writer, 200, rec.doc())
 
     async def _deltas(self, writer, params):
         ok, retry = self.admission.admit_read()

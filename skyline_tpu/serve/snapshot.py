@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from skyline_tpu.resilience.faults import fault_point
+from skyline_tpu.resilience.faults import fault_fired, fault_point
 
 
 def _now_ms() -> float:
@@ -54,6 +54,12 @@ class Snapshot:
     # the freshness lineage's published watermark (None when the engine
     # runs without the tracker); rides the WAL so restores keep lineage
     event_wm_ms: float | None = None
+    # opaque identity of the engine state these points were merged from
+    # (the partition-epoch key). Raw bytes, so it stays OFF to_doc/meta;
+    # the audit plane compares it against the live epoch key to tell a
+    # still-current snapshot from one the state has moved past. None on
+    # restored snapshots (recovered bytes carry no epoch lineage).
+    source_key: bytes | None = None
 
     @property
     def size(self) -> int:
@@ -196,6 +202,16 @@ class SnapshotStore:
             pts = np.ascontiguousarray(points, dtype=np.float32)
             if pts.base is None or pts is points:
                 pts = pts.copy()  # never alias the engine's buffer
+            if fault_fired("audit.corrupt") and pts.size:
+                # divergence drill (RUNBOOK §2l): flip one byte in the
+                # published body AFTER the copy so the engine's own state
+                # stays sound and only the served bytes lie — exactly the
+                # failure class the audit plane exists to catch. The
+                # digest below is computed over the corrupted bytes, so
+                # the snapshot is self-consistent and only the oracle
+                # comparison can see the lie.
+                pts = pts.copy()
+                pts.view(np.uint8)[0] ^= 0x01
             pts.setflags(write=False)
             self._version += 1
             if watermark_id is None:
@@ -210,6 +226,7 @@ class SnapshotStore:
                 digest=points_digest(pts),
                 meta=dict(meta),
                 event_wm_ms=event_wm_ms,
+                source_key=source_key,
             )
             prev = self._latest
             self._history.append(snap)

@@ -2116,6 +2116,18 @@ class PartitionSet:
             return self._pending[p][0]
         return np.concatenate(self._pending[p], axis=0)
 
+    def audit_state(self) -> tuple[list[np.ndarray], list[np.ndarray]]:
+        """Off-hot-path state capture for the audit plane: every
+        partition's device skyline plus its un-flushed pending rows, as
+        host arrays. One bulk device→host transfer (the ``_host_sky``
+        cache) — no flush, no merge, no epoch advance, so capturing for a
+        shadow check never perturbs the state being checked."""
+        skies = [self.skyline_host(p) for p in range(self.num_partitions)]
+        pendings = [
+            self.pending_rows_of(p) for p in range(self.num_partitions)
+        ]
+        return skies, pendings
+
     def restore_all(
         self, skies: list[np.ndarray], pendings: list[np.ndarray]
     ) -> None:

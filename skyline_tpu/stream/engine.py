@@ -232,6 +232,7 @@ class SkylineEngine:
         # touches the jitted byte-identity path. Without a hub the engine
         # still owns private instances so bench legs get the stats blocks.
         from skyline_tpu.ops.dispatch import (
+            audit_enabled,
             explain_enabled,
             freshness_enabled,
             kernel_profile_enabled,
@@ -264,6 +265,16 @@ class SkylineEngine:
             # inc even when zero so the Prometheus series registers before
             # the first query, not after it
             telemetry.inc("explain.records", 0)
+        # audit plane (ISSUE 10): sampled shadow verification of published
+        # snapshots against the host oracle, plus the canary driver the
+        # worker ticks from its idle loop. Post-publish and host-side only.
+        self.auditor = None
+        if telemetry is not None and audit_enabled():
+            from skyline_tpu.audit import Auditor
+
+            self.auditor = Auditor(self, telemetry)
+            telemetry.inc("audit.checks", 0)
+            telemetry.inc("audit.divergence", 0)
 
     def attach_snapshots(self, store) -> None:
         """Publish completed global skylines to ``store`` (a
@@ -685,6 +696,20 @@ class SkylineEngine:
                 total_ms=total_ms,
                 latency_ms=latency_ms,
             )
+        if (
+            self.auditor is not None
+            and partial_missing is None
+            and self.snapshots is not None
+        ):
+            # shadow-verify AFTER the answer is out the door (plan already
+            # finalized, snapshot already published); partial answers
+            # intentionally exclude state, so they are never audited.
+            # Observability must never take the answer down.
+            try:
+                self.auditor.maybe_check(q)
+            except Exception:
+                if self.telemetry is not None:
+                    self.telemetry.inc("audit.errors")
         self._results.append(result)
         self._inflight.pop(q.payload, None)
 
@@ -958,6 +983,8 @@ class SkylineEngine:
         }
         if self._explain_on:
             out["explain"] = self.telemetry.explain.doc()
+        if self.auditor is not None:
+            out["audit"] = self.telemetry.audit.doc()
         if self.freshness is not None:
             out["freshness"] = self.freshness.stats()
         if self.profiler is not None:

@@ -28,6 +28,7 @@ from skyline_tpu.telemetry.histogram import DEFAULT_EDGES, Histogram
 from skyline_tpu.telemetry.prometheus import (
     CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE,
 )
+from skyline_tpu.telemetry.audit import AuditRecorder
 from skyline_tpu.telemetry.explain import ExplainRecorder, QueryPlan
 from skyline_tpu.telemetry.freshness import FreshnessTracker
 from skyline_tpu.telemetry.profiler import FlightRecorder, KernelProfiler
@@ -62,6 +63,9 @@ class Telemetry:
         # per-query EXPLAIN plans (ISSUE 9): the bounded ring behind
         # GET /explain on both HTTP surfaces and /skyline?explain=1
         self.explain = ExplainRecorder(env_int("SKYLINE_EXPLAIN_RING", 256))
+        # audit plane (ISSUE 10): the shadow-verification verdict ring
+        # behind GET /audit on both HTTP surfaces
+        self.audit = AuditRecorder(env_int("SKYLINE_AUDIT_RING", 256))
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -136,6 +140,7 @@ class Telemetry:
 
 
 __all__ = [
+    "AuditRecorder",
     "Counters",
     "DEFAULT_EDGES",
     "ExplainRecorder",

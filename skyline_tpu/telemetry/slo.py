@@ -1,6 +1,6 @@
 """Declarative SLOs with multi-window burn-rate evaluation (``GET /slo``).
 
-Four objectives, each a row in a declarative table (targets are knobs, see
+Five objectives, each a row in a declarative table (targets are knobs, see
 RUNBOOK §2j):
 
 - ``read_p99``       — 99% of /skyline reads complete under
@@ -11,6 +11,10 @@ RUNBOOK §2j):
                        attempts are shed (429).
 - ``restart_rate``   — at most ``SKYLINE_SLO_RESTARTS_PER_HOUR`` supervised
                        restarts per hour.
+- ``audit_divergence`` — at most ``SKYLINE_SLO_AUDIT_DIVERGENCE`` of
+                       audited snapshots diverge from the host oracle
+                       (RUNBOOK §2l; the budget exists only so burn math
+                       is well-formed — any divergence should page).
 
 Evaluation is the standard SRE multi-window scheme: each ``evaluate()``
 samples the cumulative counters, appends them to a bounded ring, and diffs
@@ -72,6 +76,10 @@ class SloEngine:
             "restart_rate": (
                 "rate", env_float("SKYLINE_SLO_RESTARTS_PER_HOUR", 6.0),
             ),
+            "audit_divergence": (
+                "fraction",
+                env_float("SKYLINE_SLO_AUDIT_DIVERGENCE", 0.0001),
+            ),
         }
         self._admission = None  # serve-plane counters (reads_served/shed)
         self._lock = threading.Lock()
@@ -105,6 +113,9 @@ class SloEngine:
         out["shed_fraction"] = (served + shed, shed)
         restarts = int(tel.counters.get("resilience.restarts"))
         out["restart_rate"] = (restarts, restarts)
+        checks = int(tel.counters.get("audit.checks"))
+        div = int(tel.counters.get("audit.divergence"))
+        out["audit_divergence"] = (checks, div)
         return out
 
     def _window(self, samples, now_s: float, window_s: float, name: str):

@@ -49,6 +49,74 @@ def assert_same_set(a, b):
     np.testing.assert_allclose(sorted_rows(a), sorted_rows(b))
 
 
+def gen_points(rng, n, d, kind) -> np.ndarray:
+    """Shared workload shapes for the byte-identity property grids
+    (uniform / correlated / anti-correlated), float32 in [0, 1]."""
+    if kind == "uniform":
+        return rng.random((n, d)).astype(np.float32)
+    if kind == "correlated":
+        base = rng.random((n, 1))
+        return np.clip(
+            base + rng.normal(0.0, 0.05, (n, d)), 0.0, 1.0
+        ).astype(np.float32)
+    # anti-correlated: first dim fights the second, rest random
+    base = rng.random((n, d))
+    x = base.copy()
+    x[:, 0] = 1.0 - base[:, min(1, d - 1)]
+    return x.astype(np.float32)
+
+
+def fill_pset(pset, rng, x, P, max_id=None) -> None:
+    """Route ``x`` across ``P`` partitions at random and flush once — the
+    shared per-test state builder."""
+    if max_id is None:
+        max_id = x.shape[0]
+    pids = rng.integers(0, P, x.shape[0])
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=max_id, now_ms=0.0)
+    pset.flush_all()
+
+
+def merge_state(pset):
+    """One global merge with points: (counts, survivors, global_count,
+    points) as host arrays — the digest the identity asserts compare."""
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    return np.asarray(counts), np.asarray(surv), int(g), np.asarray(pts)
+
+
+def assert_same_merge(a, b, ctx="") -> None:
+    """Byte-identity of two ``merge_state`` results (order included)."""
+    assert (a[0] == b[0]).all(), f"counts diverge {ctx}"
+    assert (a[1] == b[1]).all(), f"survivors diverge {ctx}"
+    assert a[2] == b[2], f"global count diverges {ctx}"
+    assert a[3].tobytes() == b[3].tobytes(), f"points diverge {ctx}"
+
+
+def host_oracle(rows) -> np.ndarray:
+    """The independent O(n^2 d) numpy skyline oracle, rows in canonical
+    order as float32 — what the audit plane compares published answers
+    against (skyline_tpu/audit)."""
+    from skyline_tpu.audit import canonical_rows
+    from skyline_tpu.ops.dominance import skyline_np
+
+    rows = np.asarray(rows, dtype=np.float32)
+    if rows.shape[0] == 0:
+        return rows
+    return canonical_rows(np.asarray(skyline_np(rows), dtype=np.float32))
+
+
+def points_digest_of(points) -> str:
+    """Digest of a point buffer under the serve plane's scheme — lets
+    tests compare engine output to a published snapshot's ``digest``."""
+    from skyline_tpu.serve.snapshot import points_digest
+
+    return points_digest(
+        np.ascontiguousarray(np.asarray(points, dtype=np.float32))
+    )
+
+
 def parse_prometheus_text(text: str) -> dict:
     """Minimal Prometheus text-exposition (0.0.4) parser for assertions.
 

@@ -1,0 +1,443 @@
+"""Online audit plane (ISSUE 10): sampled shadow verification, divergence
+repro bundles, and correctness canaries.
+
+Pins the tentpole's contract end to end: the canonical-row/first-diff
+comparison units, the AuditRecorder ring + canary coverage map, the
+engine-owned auditor (organic checks against the host oracle, the
+moved-state validity skip, the deterministic sampling gate), the
+``audit.corrupt`` divergence drill through detection, counters, bundle
+freezing, and the offline ``python -m skyline_tpu.audit replay`` CLI,
+the known-answer canaries for every merge decision path, both HTTP
+surfaces' ``GET /audit`` (with the trace_id join into /explain and
+/trace), the ``audit_divergence`` SLO row, and the Prometheus counters.
+
+State builders and oracle/digest helpers are the shared conftest ones —
+the same code the merge-identity and explain suites use.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.audit import Auditor, canonical_rows, first_diff
+from skyline_tpu.metrics.httpstats import StatsServer
+from skyline_tpu.serve import SnapshotStore
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry.audit import AuditRecorder
+from conftest import (
+    fill_pset,
+    gen_points,
+    host_oracle,
+    points_digest_of,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _mk_engine(tel, d=3, P=4):
+    eng = SkylineEngine(
+        EngineConfig(parallelism=P, dims=d, domain_max=1000.0,
+                     buffer_size=256, emit_skyline_points=True),
+        telemetry=tel,
+    )
+    eng.attach_snapshots(SnapshotStore())
+    return eng
+
+
+def _drive_one(eng, rng, n=1200, d=3, qid="q0"):
+    x = (gen_points(rng, n, d, "uniform") * 999.0 + 1.0).astype(np.float32)
+    eng.process_records(np.arange(n), x, now_ms=0.0)
+    eng.process_trigger(f"{qid},0", now_ms=1.0)
+    return x, eng.poll_results()
+
+
+# ----------------------------------------------------------- comparison units
+
+
+def test_canonical_rows_and_first_diff(rng):
+    a = gen_points(rng, 64, 3, "uniform")
+    shuffled = a[rng.permutation(64)]
+    assert canonical_rows(a).tobytes() == canonical_rows(shuffled).tobytes()
+    assert canonical_rows(a).dtype == np.float32
+    # identical sets (any order) -> no diff
+    assert first_diff(a, shuffled) is None
+    assert first_diff(np.empty((0, 3)), np.empty((0, 3))) is None
+    # one mutated row -> a located diff with both rows reported
+    b = a.copy()
+    b[17, 0] += 0.5
+    d = first_diff(b, a)
+    assert d is not None and d["published_rows"] == d["oracle_rows"] == 64
+    assert d["published_row"] != d["oracle_row"]
+    assert 0 <= d["index"] < 64
+    # strict-prefix case: the diff points one past the shorter side
+    d = first_diff(canonical_rows(a)[:10], canonical_rows(a))
+    assert d["index"] == 10 and d["published_row"] is None
+    assert d["oracle_rows"] == 64
+
+
+# ------------------------------------------------------------- recorder ring
+
+
+def test_recorder_ring_divergence_pinning_and_coverage():
+    rec = AuditRecorder(capacity=4)
+    assert rec.latest() is None and len(rec) == 0
+    for i in range(5):
+        rec.add({"kind": "organic", "ok": True, "trace_id": f"t-{i}"})
+    # the diverging record falls off the ring below, but its evidence
+    # (bundle path + last_divergence) must survive eviction
+    rec.add({"kind": "organic", "ok": False, "trace_id": "t-bad",
+             "bundle": "/tmp/bundle-v9-1"})
+    for i in range(6, 11):
+        rec.add({"kind": "organic", "ok": True, "trace_id": f"t-{i}"})
+    doc = rec.doc()
+    assert doc["checks_total"] == 11 and doc["ring_depth"] == 4
+    assert doc["partial"] is True and doc["ok"] is False
+    assert doc["divergence_total"] == 1
+    assert doc["last_divergence"]["trace_id"] == "t-bad"
+    assert doc["bundles"] == ["/tmp/bundle-v9-1"]
+    assert rec.by_trace("t-bad") is None  # evicted from the ring itself
+    assert rec.by_trace("t-10")["seq"] == 11
+    # canary coverage map folds per-path outcomes
+    rec.record_canary("flat", True)
+    rec.record_canary("flat", False)
+    cov = rec.doc()["canaries"]["flat"]
+    assert cov["runs"] == 2 and cov["ok"] == 1 and cov["last_ok"] is False
+
+
+# ------------------------------------------------------- organic engine checks
+
+
+def test_engine_organic_check_passes_and_joins_trace(monkeypatch):
+    monkeypatch.delenv("SKYLINE_AUDIT_SAMPLE", raising=False)
+    tel = Telemetry()
+    eng = _mk_engine(tel)
+    assert eng.auditor is not None
+    x, results = _drive_one(eng, np.random.default_rng(3))
+    assert len(results) == 1
+    counters = tel.counters.snapshot()
+    assert counters.get("audit.checks") == 1
+    assert counters.get("audit.divergence", 0) == 0
+    doc = tel.audit.doc()
+    assert doc["ok"] is True and doc["checks_total"] == 1
+    check = doc["last_check"]
+    assert check["kind"] == "organic" and check["ok"] is True
+    assert check["first_diff"] is None and check["bundle"] is None
+    # the check record carries the snapshot's identity: trace joins the
+    # result, digest matches the serve scheme over the published points
+    assert check["trace_id"] == results[0]["trace_id"]
+    snap = eng.snapshots.latest()
+    assert check["digest"] == snap.digest == points_digest_of(snap.points)
+    # the published answer really is the independent oracle's
+    assert canonical_rows(snap.points).tobytes() == host_oracle(x).tobytes()
+    # satellite: the check joins /trace (span ring) and the flight ring
+    span = [s for s in tel.spans.snapshot() if s["name"] == "audit/check"]
+    assert span and span[-1]["trace_id"] == check["trace_id"]
+    notes = [e for e in tel.flight.snapshot() if e["kind"] == "audit.check"]
+    assert notes and notes[-1]["trace_id"] == check["trace_id"]
+    # engine stats expose the verdict document
+    assert eng.stats()["audit"]["checks_total"] == 1
+
+
+def test_moved_state_skips_instead_of_fabricating(monkeypatch):
+    tel = Telemetry()
+    eng = _mk_engine(tel)
+    rng = np.random.default_rng(7)
+    _drive_one(eng, rng)
+    # flush fresh rows past the published snapshot: the live epoch key no
+    # longer matches the snapshot's source_key, so a check must NOT run
+    x = (gen_points(rng, 200, 3, "uniform") * 999.0 + 1.0).astype(np.float32)
+    eng.process_records(np.arange(2000, 2200), x, now_ms=2.0)
+    eng.pset.flush_all()
+    assert eng.auditor.check() is None
+    counters = tel.counters.snapshot()
+    assert counters.get("audit.skips") == 1
+    assert counters.get("audit.checks") == 1  # only the organic one above
+    skips = [e for e in tel.flight.snapshot() if e["kind"] == "audit.skip"]
+    assert skips and skips[-1]["reason"] == "state_moved"
+
+
+def test_sampling_accumulator_is_deterministic(monkeypatch):
+    tel = Telemetry()
+    eng = _mk_engine(tel)
+    ran = []
+    monkeypatch.setattr(eng.auditor, "check", lambda q=None: ran.append(q))
+    eng.auditor.sample = 0.25
+    for i in range(8):
+        eng.auditor.maybe_check(i)
+    assert ran == [3, 7]  # every 4th result, no RNG
+    eng.auditor.sample = 0.0
+    eng.auditor.maybe_check(99)
+    assert len(ran) == 2
+    eng.auditor.sample = 1.0
+    eng.auditor.maybe_check(100)
+    assert ran[-1] == 100
+
+
+def test_canary_interval_gating():
+    tel = Telemetry()
+    eng = _mk_engine(tel)
+    aud = eng.auditor
+    aud.canary_interval_s = 300.0
+    assert aud.maybe_canary(now_s=0.0) is False  # first tick arms only
+    assert aud.maybe_canary(now_s=299.0) is False
+    assert aud.maybe_canary(now_s=301.0) is True
+    assert tel.counters.snapshot().get("audit.canary_runs") == 5
+    aud.canary_interval_s = 0.0
+    assert aud.maybe_canary(now_s=9999.0) is False  # 0 disables
+
+
+# ------------------------------------------------- divergence drill + replay
+
+
+def test_corrupt_drill_divergence_bundle_and_replay(monkeypatch, tmp_path):
+    from skyline_tpu.resilience import faults
+
+    monkeypatch.setenv("SKYLINE_AUDIT_DIR", str(tmp_path))
+    faults.install_plan(faults.FaultPlan.parse("corrupt@audit.corrupt:1"))
+    try:
+        tel = Telemetry()
+        eng = _mk_engine(tel)
+        _, results = _drive_one(eng, np.random.default_rng(11))
+        assert len(results) == 1
+    finally:
+        faults.clear()
+    counters = tel.counters.snapshot()
+    assert counters.get("audit.checks") == 1
+    assert counters.get("audit.divergence") == 1
+    doc = tel.audit.doc()
+    assert doc["ok"] is False and doc["divergence_total"] == 1
+    check = doc["last_divergence"]
+    assert check["first_diff"] is not None
+    # the flight ring carries the divergence, trace-tagged
+    notes = [
+        e for e in tel.flight.snapshot() if e["kind"] == "audit.divergence"
+    ]
+    assert notes and notes[-1]["trace_id"] == check["trace_id"]
+    # the SLO row burned
+    slo = tel.slo.evaluate()
+    row = slo["slos"]["audit_divergence"]
+    assert row["breach"] is True and slo["ok"] is False
+
+    # a complete, self-contained bundle was frozen
+    bundle = check["bundle"]
+    assert bundle and bundle.startswith(str(tmp_path))
+    assert doc["bundles"] == [bundle]
+    for fname in ("manifest.json", "checkpoint.npz", "published.npy",
+                  "oracle.npy", "explain.json"):
+        assert os.path.exists(os.path.join(bundle, fname)), fname
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["schema"] == 1
+    assert manifest["trace_id"] == check["trace_id"]
+    assert manifest["first_diff"] == check["first_diff"]
+    assert manifest["has_explain"] is True
+    knobs = {k["name"] for k in manifest["knobs"]}
+    assert "SKYLINE_AUDIT_SAMPLE" in knobs and "SKYLINE_MERGE_TREE" in knobs
+    # published really is the corrupted bytes, oracle the honest answer
+    published = np.load(os.path.join(bundle, "published.npy"))
+    oracle = np.load(os.path.join(bundle, "oracle.npy"))
+    assert first_diff(published, oracle) == manifest["first_diff"]
+
+    # offline replay reproduces the diff and acquits the engine (the
+    # drill corrupted published bytes, not the merge)
+    r = subprocess.run(
+        [sys.executable, "-m", "skyline_tpu.audit", "replay", bundle,
+         "--json"],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    verdict = json.loads(r.stdout)
+    assert verdict["reproduced"] is True
+    assert verdict["engine_diverges"] is False
+    assert verdict["recomputed_first_diff"] == manifest["first_diff"]
+    assert verdict["replay_plan"]["merge"]["path"]
+    # human rendering names the acquittal and the decision diff
+    r2 = subprocess.run(
+        [sys.executable, "-m", "skyline_tpu.audit", "replay", bundle],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r2.returncode == 0
+    assert "reproduced: YES" in r2.stdout
+    assert "engine: sound" in r2.stdout
+
+
+# ------------------------------------------------------------------- canaries
+
+
+def test_canaries_cover_every_merge_path(monkeypatch):
+    for knob in ("SKYLINE_MERGE_TREE", "SKYLINE_MERGE_CACHE",
+                 "SKYLINE_MERGE_PRUNE"):
+        monkeypatch.delenv(knob, raising=False)
+    from skyline_tpu.audit.canary import CANARIES, run_canaries
+
+    assert [name for name, _ in CANARIES] == [
+        "flat", "tree", "cache_hit", "tree_delta", "host",
+    ]
+    tel = Telemetry()
+    records = run_canaries(tel)
+    assert len(records) == 5
+    for rec in records:
+        assert rec["ok"] is True, rec
+        assert rec["first_diff"] is None
+    # path steering is real: each canary's merge actually TOOK the
+    # decision path it claims to cover (host has no plan to attest)
+    taken = {r["path"]: r["taken"] for r in records}
+    assert taken == {"flat": "flat", "tree": "tree",
+                     "cache_hit": "cache_hit", "tree_delta": "tree_delta",
+                     "host": "host"}
+    counters = tel.counters.snapshot()
+    assert counters.get("audit.checks") == 5
+    assert counters.get("audit.canary_runs") == 5
+    assert counters.get("audit.divergence", 0) == 0
+    cov = tel.audit.doc()["canaries"]
+    assert set(cov) == set(taken)
+    assert all(v["last_ok"] for v in cov.values())
+
+
+def test_canary_catches_a_broken_merge(monkeypatch):
+    # sabotage the flat canary's expectation: a detector that cannot fail
+    # proves nothing. A wrong answer must count as a divergence.
+    from skyline_tpu.audit import canary
+
+    def broken():
+        ok, detail = canary._canary_flat()
+        detail["first_diff"] = {"index": 0}
+        return False, detail
+
+    monkeypatch.setattr(
+        canary, "CANARIES", (("flat", broken),) + tuple(canary.CANARIES[1:])
+    )
+    tel = Telemetry()
+    records = canary.run_canaries(tel)
+    assert records[0]["ok"] is False
+    assert tel.counters.snapshot().get("audit.divergence") == 1
+    assert tel.audit.doc()["canaries"]["flat"]["last_ok"] is False
+    # a CRASHING canary is a failing canary, not an unhandled error
+    monkeypatch.setattr(
+        canary, "CANARIES",
+        (("flat", lambda: (_ for _ in ()).throw(RuntimeError("boom"))),),
+    )
+    tel2 = Telemetry()
+    recs = canary.run_canaries(tel2)
+    assert recs[0]["ok"] is False and "boom" in recs[0]["error"]
+    assert tel2.counters.snapshot().get("audit.divergence") == 1
+
+
+# -------------------------------------------------------------- HTTP surfaces
+
+
+def test_statsserver_audit_endpoint():
+    tel = Telemetry()
+    tel.audit.add({"kind": "organic", "ok": True, "trace_id": "t-a"})
+    tel.audit.record_canary("flat", True)
+    srv = StatsServer(lambda: {}, port=0, telemetry=tel)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _get(f"{base}/audit")
+        doc = json.loads(body)
+        assert status == 200 and doc["ok"] is True
+        assert doc["checks_total"] == 1
+        assert doc["canaries"]["flat"]["runs"] == 1
+        status, body = _get(f"{base}/audit?trace_id=t-a")
+        assert status == 200 and json.loads(body)["trace_id"] == "t-a"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/audit?trace_id=t-nope")
+        assert ei.value.code == 404
+        assert json.load(ei.value)["ring"]["checks_total"] == 1
+    finally:
+        srv.close()
+    # no telemetry hub: /audit answers 404, not 500
+    srv = StatsServer(lambda: {}, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://127.0.0.1:{srv.port}/audit")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+@pytest.fixture
+def audit_worker(monkeypatch):
+    monkeypatch.delenv("SKYLINE_AUDIT", raising=False)
+    monkeypatch.delenv("SKYLINE_AUDIT_SAMPLE", raising=False)
+    from skyline_tpu.bridge import MemoryBus, SkylineWorker
+    from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+
+    bus = MemoryBus()
+    worker = SkylineWorker(
+        bus, EngineConfig(parallelism=2, dims=3), stats_port=0,
+        serve_port=0,
+    )
+    rng = np.random.default_rng(5)
+    x = rng.uniform(1, 999, size=(1500, 3)).astype(np.float32)
+    bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, row) for i, row in enumerate(x)],
+    )
+    bus.produce("queries", format_trigger(0, 0))
+    while worker.step() > 0:
+        pass
+    try:
+        yield worker
+    finally:
+        worker.close()
+
+
+def test_worker_audit_on_both_surfaces(audit_worker, prom_parse):
+    # the organic check already ran at emit time (sample defaults to 1.0)
+    worker = audit_worker
+    worker.engine.auditor.run_canaries()
+    for base in (
+        f"http://127.0.0.1:{worker.serve_server.port}",
+        f"http://127.0.0.1:{worker.stats_server.port}",
+    ):
+        status, body = _get(f"{base}/audit")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["ok"] is True and doc["divergence_total"] == 0
+        assert doc["checks_total"] >= 6  # 1 organic + 5 canaries
+        assert set(doc["canaries"]) == {
+            "flat", "tree", "cache_hit", "tree_delta", "host",
+        }
+        # the trace join works against the organic check's snapshot
+        organic = [
+            c for c in worker.telemetry.audit.snapshot()
+            if c["kind"] == "organic"
+        ]
+        trace = organic[-1]["trace_id"]
+        status, body = _get(f"{base}/audit?trace_id={trace}")
+        assert status == 200 and json.loads(body)["trace_id"] == trace
+    # Prometheus: both counters exported, zero divergence
+    _, body = _get(f"http://127.0.0.1:{worker.stats_server.port}/metrics")
+    series = prom_parse(body.decode())
+    assert series["skyline_audit_checks_total"][0][1] >= 6.0
+    assert series["skyline_audit_divergence_total"][0][1] == 0.0
+    # the SLO surface carries the audit row, green
+    _, body = _get(f"http://127.0.0.1:{worker.stats_server.port}/slo")
+    slo = json.loads(body)
+    assert slo["slos"]["audit_divergence"]["breach"] is False
+
+
+def test_audit_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("SKYLINE_AUDIT", "0")
+    tel = Telemetry()
+    eng = _mk_engine(tel)
+    assert eng.auditor is None
+    _, results = _drive_one(eng, np.random.default_rng(2))
+    assert len(results) == 1
+    assert tel.counters.snapshot().get("audit.checks", 0) == 0
+    assert "audit" not in eng.stats()

@@ -464,6 +464,19 @@ def test_property_plans_reconcile_with_counters(policy, dist, d,
         assert cascade["prefilter_enabled"] is False
         assert all(p["tree"] is None for p in plans)
 
+    # the final answer must equal the independent host oracle over the
+    # whole stream, and the published digest must be the serve scheme's —
+    # the same comparisons the audit plane runs online (conftest helpers)
+    from skyline_tpu.audit import canonical_rows
+
+    from conftest import host_oracle, points_digest_of
+
+    _, x = _make_stream(dist, d, 1200, seed=11)
+    final = np.asarray(results[-1]["skyline_points"], dtype=np.float32)
+    assert canonical_rows(final).tobytes() == host_oracle(x).tobytes()
+    snap = eng.snapshots.latest()
+    assert snap.digest == points_digest_of(snap.points)
+
     # byte-identity: the identical run with the plane off emits the same
     # answers, point bytes included
     monkeypatch.setenv("SKYLINE_EXPLAIN", "0")

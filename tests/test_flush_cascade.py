@@ -12,22 +12,8 @@ import pytest
 from skyline_tpu.parallel.mesh import make_mesh
 from skyline_tpu.stream import EngineConfig, SkylineEngine
 from skyline_tpu.stream.batched import PartitionSet
-from conftest import assert_same_set
-
-
-def _gen(rng, n, d, kind):
-    if kind == "uniform":
-        return rng.random((n, d)).astype(np.float32)
-    if kind == "correlated":
-        base = rng.random((n, 1))
-        return np.clip(
-            base + rng.normal(0.0, 0.05, (n, d)), 0.0, 1.0
-        ).astype(np.float32)
-    # anti-correlated: first dim fights the second, rest random
-    base = rng.random((n, d))
-    x = base.copy()
-    x[:, 0] = 1.0 - base[:, min(1, d - 1)]
-    return x.astype(np.float32)
+# workload generator shared via conftest.py (satellite of ISSUE 10)
+from conftest import assert_same_set, gen_points as _gen
 
 
 def _run_rounds(pset, rng, x, P, rounds=2):

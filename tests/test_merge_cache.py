@@ -22,19 +22,17 @@ from skyline_tpu.metrics.collector import Counters
 from skyline_tpu.stream.batched import PartitionSet
 from skyline_tpu.workload.generators import anti_correlated, correlated, uniform
 
+# shared state/digest helpers live in conftest.py (satellite of ISSUE 10);
+# max_id=0 preserves this file's historical watermark bookkeeping
+from conftest import fill_pset, merge_state
+
 
 def _fill(pset, rng, x, P, max_id=0):
-    pids = rng.integers(0, P, x.shape[0])
-    for p in range(P):
-        rows = np.ascontiguousarray(x[pids == p])
-        if rows.shape[0]:
-            pset.add_batch(p, rows, max_id=max_id, now_ms=0.0)
-    pset.flush_all()
+    fill_pset(pset, rng, x, P, max_id=max_id)
 
 
 def _merge(pset):
-    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
-    return np.asarray(counts), np.asarray(surv), int(g), np.asarray(pts)
+    return merge_state(pset)
 
 
 def test_repeated_trigger_is_pure_cache_hit(rng):

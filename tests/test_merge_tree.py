@@ -8,42 +8,9 @@ import pytest
 
 from skyline_tpu.stream.batched import PartitionSet
 from skyline_tpu.stream.engine import EngineConfig, SkylineEngine
-
-
-def _gen(rng, n, d, kind):
-    if kind == "uniform":
-        return rng.random((n, d)).astype(np.float32)
-    if kind == "correlated":
-        base = rng.random((n, 1))
-        return np.clip(
-            base + rng.normal(0.0, 0.05, (n, d)), 0.0, 1.0
-        ).astype(np.float32)
-    # anti-correlated: first dim fights the second, rest random
-    base = rng.random((n, d))
-    x = base.copy()
-    x[:, 0] = 1.0 - base[:, min(1, d - 1)]
-    return x.astype(np.float32)
-
-
-def _fill(pset, rng, x, P):
-    pids = rng.integers(0, P, x.shape[0])
-    for p in range(P):
-        rows = np.ascontiguousarray(x[pids == p])
-        if rows.shape[0]:
-            pset.add_batch(p, rows, max_id=x.shape[0], now_ms=0.0)
-    pset.flush_all()
-
-
-def _merge(pset):
-    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
-    return np.asarray(counts), np.asarray(surv), int(g), pts
-
-
-def _assert_same(a, b, ctx=""):
-    assert (a[0] == b[0]).all(), f"counts diverge {ctx}"
-    assert (a[1] == b[1]).all(), f"survivors diverge {ctx}"
-    assert a[2] == b[2], f"global count diverges {ctx}"
-    assert a[3].tobytes() == b[3].tobytes(), f"points diverge {ctx}"
+# shared state/digest helpers live in conftest.py (the audit plane's
+# tests reuse the same builders — satellite of ISSUE 10)
+from conftest import assert_same_merge, fill_pset, gen_points, merge_state
 
 
 @pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
@@ -61,9 +28,9 @@ def test_tree_matches_flat(monkeypatch, kind, d, P, prune):
         monkeypatch.setenv("SKYLINE_MERGE_TREE", tree)
         rng = np.random.default_rng(17)
         pset = PartitionSet(P, d)
-        _fill(pset, rng, _gen(rng, int(1200), d, kind), P)
-        results[tree] = _merge(pset)
-    _assert_same(
+        fill_pset(pset, rng, gen_points(rng, int(1200), d, kind), P)
+        results[tree] = merge_state(pset)
+    assert_same_merge(
         results["1"], results["0"], f"(kind={kind} d={d} P={P} prune={prune})"
     )
 
@@ -86,13 +53,13 @@ def test_all_partitions_pruned_but_one(monkeypatch):
             weak = (0.5 + rng.random((400, d)) * 0.5).astype(np.float32)
             pset.add_batch(p, weak, max_id=4000, now_ms=0.0)
         pset.flush_all()
-        return pset, _merge(pset)
+        return pset, merge_state(pset)
 
     pruned_set, pruned = build("1", "1")
     noprune_set, noprune = build("1", "0")
     _, flat = build("0", "1")
-    _assert_same(pruned, flat, "(pruned tree vs flat)")
-    _assert_same(noprune, flat, "(unpruned tree vs flat)")
+    assert_same_merge(pruned, flat, "(pruned tree vs flat)")
+    assert_same_merge(noprune, flat, "(unpruned tree vs flat)")
     assert pruned_set.last_tree_info["partitions_pruned"] == P - 1
     assert pruned_set.last_tree_info["levels"] == 0  # single surviving leaf
     assert noprune_set.last_tree_info["partitions_pruned"] == 0
@@ -115,8 +82,8 @@ def test_single_nonempty_partition(monkeypatch):
             2, rng.random((700, d)).astype(np.float32), max_id=700, now_ms=0.0
         )
         pset.flush_all()
-        results[tree] = (_merge(pset), pset.last_tree_info)
-    _assert_same(results["1"][0], results["0"][0], "(single partition)")
+        results[tree] = (merge_state(pset), pset.last_tree_info)
+    assert_same_merge(results["1"][0], results["0"][0], "(single partition)")
     assert results["1"][1]["levels"] == 0
     assert results["0"][1] is None  # flat path never ran the tree
 
@@ -142,15 +109,15 @@ def test_delta_merges_route_through_tree(monkeypatch):
                 if rows.shape[0]:
                     pset.add_batch(p, rows, max_id=len(x), now_ms=0.0)
             pset.flush_all()
-            out.append(_merge(pset))
+            out.append(merge_state(pset))
             # repeat trigger over unchanged state: exact cache hit
-            out.append(_merge(pset))
+            out.append(merge_state(pset))
         return out, pset
 
     a, pa = run("1")
     b, pb = run("0")
     for i, (ra, rb) in enumerate(zip(a, b)):
-        _assert_same(ra, rb, f"(round {i})")
+        assert_same_merge(ra, rb, f"(round {i})")
     # both sides took the same hit/miss/delta decisions
     assert pa.merge_cache_hits == pb.merge_cache_hits > 0
     assert pa.merge_delta_merges == pb.merge_delta_merges > 0
