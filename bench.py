@@ -199,6 +199,41 @@ def merge_cache_leg(cfg, ids, x, required) -> tuple[dict, dict, dict]:
     return mc, st.get("merge_tree", {}), st.get("flush_cascade", {})
 
 
+def sorted_sfs_leg(cfg, ids, x, required) -> dict:
+    """Dispatch truth for the sorted-order SFS flush path (ISSUE 11): one
+    telemetry-attached engine over the bench window, stamping which flush
+    path each dispatch actually took (FlightRecorder ``flush.dispatch``
+    entries), the knob mode, and the chooser's measured per-variant flush
+    signatures. The byte-identity + speedup A/B lives in
+    ``benchmarks/sorted_sfs.py`` (artifacts/sorted_sfs_ab.json); this
+    block is what lets ``scripts/bench_compare.py`` catch the host path
+    silently disappearing from the hot loop."""
+    from skyline_tpu.ops.dispatch import sorted_sfs_mode
+    from skyline_tpu.stream import SkylineEngine
+    from skyline_tpu.telemetry import Telemetry
+
+    eng = SkylineEngine(cfg, telemetry=Telemetry())
+    n = x.shape[0]
+    chunk = 65536
+    for i in range(0, n, chunk):
+        eng.process_records(ids[i : i + chunk], x[i : i + chunk])
+    eng.process_trigger(f"0,{required}")
+    eng.poll_results()
+    paths: dict[str, int] = {}
+    for e in eng.telemetry.flight.snapshot():
+        if e.get("kind") == "flush.dispatch":
+            p = str(e.get("path", "unknown"))
+            paths[p] = paths.get(p, 0) + 1
+    block: dict = {"mode": sorted_sfs_mode(), "dispatch_paths": paths}
+    prof = eng.pset._flush_prof
+    if prof is not None:
+        block["flush_signatures"] = [
+            {k: r[k] for k in ("variant", "n_bucket", "calls", "ema_ms")}
+            for r in prof.doc()["kernels"]
+        ]
+    return block
+
+
 def serve_leg(d: int, algo: str) -> dict:
     """Serving-plane microbenchmark: read latency p50/p99 and shed rate.
 
@@ -478,6 +513,12 @@ def child_main(backend: str) -> None:
         merge_tree = {"error": f"{type(e).__name__}: {e}"}
         flush_cascade = {"error": f"{type(e).__name__}: {e}"}
     try:
+        sorted_sfs = sorted_sfs_leg(
+            cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
+        )
+    except Exception as e:  # pragma: no cover - diagnostic path
+        sorted_sfs = {"error": f"{type(e).__name__}: {e}"}
+    try:
         analysis = analysis_stamp()
     except Exception as e:  # pragma: no cover - diagnostic path
         analysis = {"error": f"{type(e).__name__}: {e}"}
@@ -509,6 +550,7 @@ def child_main(backend: str) -> None:
                 "serve": serve,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
+                "sorted_sfs": sorted_sfs,
                 "resilience": resilience,
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,

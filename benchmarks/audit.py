@@ -143,7 +143,9 @@ def bench_e2e(n: int, d: int, repeats: int) -> dict:
 
 def bench_check(n: int, d: int, repeats: int = 20) -> dict:
     """One sampled check in isolation over a settled engine — the
-    marginal cost SKYLINE_AUDIT_SAMPLE dials."""
+    marginal cost SKYLINE_AUDIT_SAMPLE dials — under BOTH host oracles
+    (SKYLINE_AUDIT_ORACLE), so the artifact carries the sorted-vs-
+    quadratic A/B itself."""
     from skyline_tpu.workload.generators import anti_correlated
 
     rng = np.random.default_rng(1)
@@ -153,18 +155,20 @@ def bench_check(n: int, d: int, repeats: int = 20) -> dict:
     eng.process_trigger("q,0")
     eng.poll_results()
     sky = int(eng.snapshots.latest().size)
-    t0 = time.perf_counter()
-    for _ in range(repeats):
-        record = eng.auditor.check()
-        assert record is not None and record["ok"], record
-    per_check_ms = (time.perf_counter() - t0) / repeats * 1000.0
-    return {
-        "n": n,
-        "d": d,
-        "skyline_rows": sky,
-        "repeats": repeats,
-        "check_ms": round(per_check_ms, 2),
-    }
+    out = {"n": n, "d": d, "skyline_rows": sky, "repeats": repeats}
+    for kind, reps in (("sorted", repeats), ("quadratic", 3)):
+        os.environ["SKYLINE_AUDIT_ORACLE"] = kind
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            record = eng.auditor.check()
+            assert record is not None and record["ok"], record
+            assert record["oracle"] == kind, record
+        per_ms = (time.perf_counter() - t0) / reps * 1000.0
+        out["check_ms" if kind == "sorted" else "check_ms_quadratic"] = (
+            round(per_ms, 2)
+        )
+    del os.environ["SKYLINE_AUDIT_ORACLE"]
+    return out
 
 
 def bench_canary(sweeps: int = 5) -> dict:

@@ -1,0 +1,169 @@
+"""A/B: sorted-order SFS cascade vs the device dominance kernels
+(ISSUE 11) — byte-identity asserted at every grid point, speedup
+reported honestly.
+
+Two legs:
+
+- mask grid: ``skyline_keep_np`` (the real dispatch path) with
+  ``SKYLINE_SORTED_SFS`` forced off (device scan kernel) vs on (host
+  cascade, ``ops/sorted_sfs.py``) over kind × d∈{4,8} × N. The keep
+  masks — and therefore the surviving rows — must be byte-identical at
+  every point before any time is reported.
+- flush leg: the bench workload's shape (anti-correlated, mr-angle
+  routing skew, d=8) driven through a lazy-policy ``PartitionSet`` both
+  ways; asserts the published global skyline digest (count + survivor
+  vector + point bytes) is identical and reports whole-flush wall.
+  This is the number the BENCH_r06 -> r07 ``flush/merge_kernel``
+  acceptance bar (>= 2x on the CPU fallback) rides on.
+
+Writes ``artifacts/sorted_sfs_ab.json``.
+
+Usage: python benchmarks/sorted_sfs.py [--reps 3] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.kernels import _median_time  # noqa: E402
+
+KINDS = ("uniform", "correlated", "anti-correlated")
+
+
+def _gen(kind: str, rng, n: int, d: int) -> np.ndarray:
+    from skyline_tpu.workload import generators as g
+
+    fn = {
+        "uniform": g.uniform,
+        "correlated": g.correlated,
+        "anti-correlated": g.anti_correlated,
+    }[kind]
+    return fn(rng, n, d, 0, 10000)
+
+
+def _keep(mode: str, rows: np.ndarray) -> np.ndarray:
+    """One dispatch-path survivor mask under the given knob setting."""
+    from skyline_tpu.ops.dispatch import skyline_keep_np
+
+    os.environ["SKYLINE_SORTED_SFS"] = mode
+    try:
+        return skyline_keep_np(rows)
+    finally:
+        os.environ.pop("SKYLINE_SORTED_SFS", None)
+
+
+def bench_mask_grid(reps: int, sizes=(4096, 16384, 65536)) -> list[dict]:
+    out = []
+    for kind in KINDS:
+        for d in (4, 8):
+            for n in sizes:
+                rng = np.random.default_rng(11)
+                rows = _gen(kind, rng, n, d)
+                dev = _keep("off", rows)  # also warms the executable
+                srt = _keep("on", rows)
+                assert np.array_equal(dev, srt), (kind, d, n)
+                assert rows[dev].tobytes() == rows[srt].tobytes()
+                dev_s = _median_time(lambda: _keep("off", rows), reps)
+                srt_s = _median_time(lambda: _keep("on", rows), reps)
+                out.append({
+                    "kind": kind,
+                    "d": d,
+                    "n": n,
+                    "survivors": int(dev.sum()),
+                    "device_ms": round(dev_s * 1000.0, 2),
+                    "sorted_ms": round(srt_s * 1000.0, 2),
+                    "speedup": round(dev_s / srt_s, 2) if srt_s > 0 else None,
+                    "byte_identical": True,
+                })
+    return out
+
+
+def _drive_flush(mode: str, rows: np.ndarray, d: int):
+    """One engine pass under the knob: ingest -> flush_all -> merged
+    digest + the flush wall (the engine's own processing clock)."""
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+
+    os.environ["SKYLINE_SORTED_SFS"] = mode
+    try:
+        eng = SkylineEngine(EngineConfig(
+            parallelism=4, dims=d, domain_max=10000.0, algo="mr-angle",
+            buffer_size=8192, flush_policy="lazy",
+            window_capacity=1 << 17, emit_skyline_points=True,
+        ))
+        n = rows.shape[0]
+        ids = np.arange(n, dtype=np.int64)
+        chunk = 8192
+        for i in range(0, n, chunk):
+            eng.process_records(ids[i : i + chunk], rows[i : i + chunk])
+        pset = eng.pset
+        t0 = time.perf_counter()
+        pset.flush_all()
+        flush_s = time.perf_counter() - t0
+        counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+        digest = (
+            int(g),
+            np.asarray(surv).tobytes(),
+            np.asarray(pts).tobytes(),
+        )
+        return flush_s, digest
+    finally:
+        os.environ.pop("SKYLINE_SORTED_SFS", None)
+
+
+def bench_flush(n: int = 131072, d: int = 8) -> dict:
+    from skyline_tpu.workload.generators import anti_correlated
+
+    rng = np.random.default_rng(0)
+    rows = anti_correlated(rng, n, d, 0, 10000)
+    _drive_flush("off", rows[: n // 4], d)  # warm the executables
+    dev_s, dev_digest = _drive_flush("off", rows, d)
+    srt_s, srt_digest = _drive_flush("on", rows, d)
+    assert dev_digest == srt_digest, "flush paths diverged"
+    return {
+        "n": n,
+        "d": d,
+        "skyline_rows": dev_digest[0],
+        "device_flush_ms": round(dev_s * 1000.0, 1),
+        "sorted_flush_ms": round(srt_s * 1000.0, 1),
+        "speedup": round(dev_s / srt_s, 2) if srt_s > 0 else None,
+        "digest_identical": True,
+    }
+
+
+def main(argv=None) -> int:
+    import jax
+
+    ap = argparse.ArgumentParser(
+        description="sorted-order SFS cascade A/B vs device kernels"
+    )
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(REPO, "artifacts", "sorted_sfs_ab.json"),
+    )
+    a = ap.parse_args(argv)
+
+    result = {
+        "backend": jax.default_backend(),
+        "grid": bench_mask_grid(a.reps),
+        "flush": bench_flush(),
+    }
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    print(f"wrote {a.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

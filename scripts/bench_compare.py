@@ -39,9 +39,10 @@ import sys
 
 def _merge_kernel_share(parsed: dict) -> float | None:
     """``flush/merge_kernel`` as a fraction of ``profile_window_total`` —
-    the slice of the profiled window the global-merge kernels burn. The
-    pruned tournament tree exists to shrink this; a share creep means the
-    tree (or its prefilter) went dead."""
+    the slice of the profiled window the dominance kernels burn. The
+    pruned tournament tree and the sorted-order SFS flush cascade exist
+    to shrink this; a share creep means one of them (or a prefilter)
+    went dead."""
     phases = parsed.get("phase_breakdown_ms")
     if not isinstance(phases, dict):
         return None
@@ -80,11 +81,13 @@ METRICS = (
     ("flush_cascade.prefilter_drop_fraction",
      ("flush_cascade", "prefilter_drop_fraction"), True, False),
     # merge-kernel share of the profiled window (computed, lower better):
-    # the headline the pruned tree + tile skip are accountable for. Only
-    # gated on real-TPU artifacts — on the cpu-fallback the phase mix is
-    # noise-dominated (the merge kernels cost a wholly different fraction
-    # of CPU wall), so a share swing there says nothing about the tree
-    ("flush/merge_kernel share", _merge_kernel_share, False, True),
+    # the headline the pruned tree, the tile skip, and — since ISSUE 11 —
+    # the sorted-order SFS cascade are accountable for. Gated on EVERY
+    # backend: before the sorted cascade the cpu-fallback share was pinned
+    # at ~98% (noise-dominated phase mix), but it is now the acceptance
+    # number of the flush rewrite (BENCH_r06 0.98 -> r07 post-cascade), so
+    # a creep back toward the quadratic kernels must fail the compare
+    ("flush/merge_kernel share", _merge_kernel_share, False, False),
     # freshness SLI (bench.py serve_leg lineage block): read-lag p99 is the
     # end-to-end staleness readers actually saw — ingest event-time proxy
     # through flush/merge/publish to the /skyline response. Absent on older

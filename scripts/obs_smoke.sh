@@ -354,6 +354,54 @@ print(f"[obs-smoke] flush cascade digest ok: g={digests['1'][0]} identical "
       f"with cascade on ({dropped['1']} rows prefiltered) and off")
 EOF
 
+# sorted-order SFS cascade (ISSUE 11): the host dominance path the flush
+# chooser can swap in for the device kernels must not change a single
+# output byte — drive an identical lazy-policy stream with the cascade
+# forced on and off, compare global-merge digests, and assert the sorted
+# path actually ran (flush.sorted_sfs counter + flush_sorted_sfs profiler
+# variant), i.e. the identity was proven against a LIVE cascade
+JAX_PLATFORMS=cpu python - <<'EOF'
+import os
+
+import numpy as np
+
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.workload.generators import anti_correlated
+
+os.environ["SKYLINE_MERGE_CACHE"] = "0"
+digests = {}
+tels = {}
+for mode in ("on", "off"):
+    os.environ["SKYLINE_SORTED_SFS"] = mode
+    tel = Telemetry()
+    rng = np.random.default_rng(23)
+    pset = PartitionSet(4, 4, flush_policy="lazy", counters=tel.counters)
+    x = anti_correlated(rng, 4000, 4, 0, 10000).astype(np.float32)
+    pids = rng.integers(0, 4, len(x))
+    for p in range(4):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            pset.add_batch(p, rows, max_id=len(x), now_ms=0.0)
+    pset.flush_all()
+    counts, surv, g, pts = pset.global_merge_stats(emit_points=True)
+    digests[mode] = (int(g), np.asarray(surv).tobytes(), pts.tobytes())
+    tels[mode] = (dict(tel.counters.snapshot()), pset._flush_prof)
+os.environ.pop("SKYLINE_SORTED_SFS", None)
+assert digests["on"] == digests["off"], \
+    "sorted-SFS on/off merge results diverge (g or point bytes differ)"
+on_counters, on_prof = tels["on"]
+assert on_counters.get("flush.sorted_sfs", 0) > 0, \
+    "sorted path never engaged under SKYLINE_SORTED_SFS=on"
+variants = {k["variant"] for k in on_prof.doc()["kernels"]}
+assert "flush_sorted_sfs" in variants, variants
+off_counters, _ = tels["off"]
+assert off_counters.get("flush.sorted_sfs", 0) == 0, off_counters
+print(f"[obs-smoke] sorted-SFS digest ok: g={digests['on'][0]} identical "
+      f"with cascade on ({on_counters['flush.sorted_sfs']:.0f} sorted "
+      "flush(es)) and off")
+EOF
+
 # regression gate: newest two artifacts must currently pass at default
 # threshold, and an artificially regressed NEW must fail with rc 1
 python scripts/bench_compare.py

@@ -29,6 +29,8 @@ use tiny shapes.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from skyline_tpu.analysis.findings import Finding
@@ -199,6 +201,32 @@ def run(dims=DEFAULT_DIMS, n: int = 256) -> tuple[list[Finding], dict]:
             lambda xx, vv: skyline_mask_auto(xx, vv), (x, valid),
             f"skyline_mask_auto d={d} n={n}", expect_bf16=False,
         )
+        configs += 1
+
+    # sorted-SFS containment (ISSUE 11): with the host cascade FORCED on,
+    # a traced skyline_mask_auto must still lower to pure device ops —
+    # under tracing the inputs are tracers, so the host path must step
+    # aside (a leak would surface as a host callback or a concretization
+    # error). One d>2 config; d<=2 never routes to the cascade.
+    d_sorted = max(dims)
+    if d_sorted > 2:
+        prev = os.environ.get("SKYLINE_SORTED_SFS")  # lint: allow-raw-env
+        os.environ["SKYLINE_SORTED_SFS"] = "on"
+        try:
+            x = jnp.asarray(
+                rng.uniform(0, 1, (n, d_sorted)).astype(np.float32)
+            )
+            valid = jnp.asarray(np.arange(n) < n - 3)
+            findings += _trace_twice(
+                lambda xx, vv: skyline_mask_auto(xx, vv), (x, valid),
+                f"skyline_mask_auto[sorted_sfs=on] d={d_sorted} n={n}",
+                expect_bf16=False,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("SKYLINE_SORTED_SFS", None)
+            else:
+                os.environ["SKYLINE_SORTED_SFS"] = prev
         configs += 1
 
     # SFS round + incremental merge step: the two flush hot ops, with the

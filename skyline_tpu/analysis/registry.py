@@ -110,6 +110,15 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_PALLAS_INTERPRET", "bool", False,
        "run the Pallas kernels in interpret mode on CPU (lowering "
        "validation without TPU hardware)", "kernels/test"),
+    _k("SKYLINE_SORTED_SFS", "enum", "auto",
+       "sorted-order SFS dominance cascade for d>2 on non-TPU backends: "
+       "auto (per-(d,N,backend) choice from measured KernelProfiler wall "
+       "data), on (force the sorted host path), off (device kernels only)",
+       "engine", choices=("auto", "on", "off"), runbook="§2m"),
+    _k("SKYLINE_SORTED_SFS_BLOCK", "int", 8192,
+       "max scan-block width of the sorted SFS cascade (the exact "
+       "in-block pairwise tile; blocks start at 1024 and double up to "
+       "this)", "engine", runbook="§2m"),
     # -- utils -------------------------------------------------------------
     _k("SKYLINE_COMPILE_CACHE", "str", None,
        "persistent XLA compilation cache directory (default: repo-local "
@@ -330,6 +339,11 @@ KNOBS: tuple[Knob, ...] = (
        "seconds between synthetic known-answer canary sweeps over every "
        "merge path while the worker is idle (0 = off)", "audit",
        runbook="§2l"),
+    _k("SKYLINE_AUDIT_ORACLE", "enum", "sorted",
+       "host oracle the auditor verifies answers against: sorted "
+       "(dedup + sum-sorted scan, full-rate affordable) or quadratic "
+       "(the O(n²d) oracle-of-the-oracle kept for tests)", "audit",
+       choices=("sorted", "quadratic"), runbook="§2l"),
     _k("SKYLINE_SLO_AUDIT_DIVERGENCE", "float", 0.0001,
        "SLO target: max fraction of audited snapshots diverging from the "
        "host oracle", "telemetry/slo", runbook="§2l"),

@@ -7,9 +7,10 @@ tree, epoch-keyed merge cache — each verified offline by property tests
 and A/B benchmarks. This plane closes the loop ONLINE: in the serving
 process, a knob-controlled fraction of published snapshots
 (``SKYLINE_AUDIT_SAMPLE``) is recomputed from the engine's partition
-state through ``ops.dominance.skyline_np`` — the O(n²d) numpy oracle
-with every optimization structurally absent — and compared byte-for-byte
-after canonical row ordering.
+state through an independent numpy oracle (``audit/oracle.py``; the
+``SKYLINE_AUDIT_ORACLE`` knob picks the default full-rate sorted scan
+or the original O(n²d) quadratic oracle, kept as the oracle-of-the-
+oracle) and compared byte-for-byte after canonical row ordering.
 
 A divergence increments ``skyline_audit_divergence_total``, burns the
 ``audit_divergence`` SLO, and freezes a self-contained repro bundle
@@ -142,9 +143,9 @@ class Auditor:
             if skies
             else np.empty((0, self.engine.pset.dims), dtype=np.float32)
         )
-        from skyline_tpu.ops.dominance import skyline_np
+        from skyline_tpu.audit.oracle import oracle_fn, oracle_kind
 
-        oracle = np.asarray(skyline_np(union), dtype=np.float32)
+        oracle = np.asarray(oracle_fn()(union), dtype=np.float32)
         published = np.asarray(snap.points, dtype=np.float32)
         diff = first_diff(published, oracle)
         ok = diff is None
@@ -155,6 +156,7 @@ class Auditor:
             "trace_id": trace_id,
             "version": int(snap.version),
             "digest": snap.digest,
+            "oracle": oracle_kind(),
             "published_rows": int(published.shape[0]),
             "oracle_rows": int(oracle.shape[0]),
             "first_diff": diff,

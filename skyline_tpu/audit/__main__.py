@@ -64,6 +64,8 @@ def replay(bundle_path: str) -> dict:
     )
     skies, _ = engine.pset.audit_state()
     union = np.concatenate(skies, axis=0) if skies else fast
+    # offline replay is the court of appeal: always the quadratic oracle,
+    # independent of whatever SKYLINE_AUDIT_ORACLE picked online
     oracle_ck = np.asarray(skyline_np(union), dtype=np.float32)
     engine_diff = first_diff(fast, oracle_ck)
 

@@ -157,13 +157,21 @@ def _canary_tree_delta() -> tuple[bool, dict]:
 
 
 def _canary_host() -> tuple[bool, dict]:
-    """The audit oracle itself against a hand-known answer — a broken
-    ``skyline_np`` must not silently vouch for broken fast paths."""
+    """The audit oracles themselves against a hand-known answer — a
+    broken oracle must not silently vouch for broken fast paths. Both
+    the quadratic and the sorted-scan oracle must agree with the known
+    answer regardless of which one SKYLINE_AUDIT_ORACLE selects."""
+    from skyline_tpu.audit.oracle import sorted_skyline_np
     from skyline_tpu.ops.dominance import skyline_np
 
     rows, expected = _micro_state(3)
-    pts = np.asarray(skyline_np(rows), dtype=np.float32)
-    return _verdict(pts, expected, "host")
+    for fn in (skyline_np, sorted_skyline_np):
+        pts = np.asarray(fn(rows), dtype=np.float32)
+        ok, detail = _verdict(pts, expected, "host")
+        if not ok:
+            detail = {**detail, "oracle": fn.__name__}
+            return ok, detail
+    return ok, detail
 
 
 # every merge decision path the engine can take (stream/batched.py path

@@ -228,6 +228,72 @@ def profile_cost_enabled() -> bool:
     return env_bool("SKYLINE_PROFILE_COST", False)
 
 
+def sorted_sfs_mode() -> str:
+    """``SKYLINE_SORTED_SFS``: the sorted-order SFS dominance cascade for
+    d > 2 (``ops/sorted_sfs.py`` — dedup + f64 sum-sort + blocked scan
+    with exact in-block tiles for the ambiguous equal-sum band;
+    byte-identical masks, see RUNBOOK §2m). ``auto`` (default) picks per
+    (d, N, backend) signature from measured KernelProfiler wall data —
+    each candidate runs once to seed its EMA, then the cheaper one wins;
+    ``on`` forces the sorted host path, ``off`` keeps the device kernels
+    only. Host NumPy, so it only ever applies to concrete (non-traced)
+    arrays on non-TPU backends — inside jit and on TPU the device kernels
+    always run. Read lazily per call."""
+    from skyline_tpu.analysis.registry import env_str
+
+    v = env_str("SKYLINE_SORTED_SFS", "auto")
+    return v if v in ("auto", "on", "off") else "auto"
+
+
+def choose_variant(profiler, candidates, d: int, n: int, mp: bool = False):
+    """Profiler-driven dispatch: pick among ``candidates`` (variant-name
+    strings, preference-ordered) under signature (d, N-bucket, backend).
+
+    Any candidate without measured wall data runs next (first listed
+    wins), so each variant seeds its EMA exactly once per signature;
+    after that the minimum EMA wins every time. With no profiler at all,
+    the first candidate is the standing choice."""
+    if profiler is None:
+        return candidates[0]
+    emas = []
+    for c in candidates:
+        e = profiler.ema_ms(c, d, n, mp)
+        if e is None:
+            return c  # unmeasured: explore it now, choose on data after
+        emas.append((e, c))
+    return min(emas)[1]
+
+
+# the profiler skyline_mask_auto's host-path records into / chooses from;
+# the engine shares its telemetry profiler here so /profile and EXPLAIN
+# see mask dispatches too (tests and bare callers get a private default)
+_MASK_PROFILER = None
+
+
+def register_profiler(profiler) -> None:
+    """Share an engine's KernelProfiler with the dispatch chooser (last
+    registration wins — profiler data is observability, not state)."""
+    global _MASK_PROFILER
+    _MASK_PROFILER = profiler
+
+
+def _mask_profiler():
+    global _MASK_PROFILER
+    if _MASK_PROFILER is None:
+        from skyline_tpu.telemetry.profiler import KernelProfiler
+
+        _MASK_PROFILER = KernelProfiler()
+    return _MASK_PROFILER
+
+
+def _is_concrete(x) -> bool:
+    """True when ``x`` is a real array (host or committed device), not a
+    tracer — the jit boundary the host path must never cross."""
+    import jax
+
+    return not isinstance(x, jax.core.Tracer)
+
+
 def skyline_mask_auto(x, valid=None):
     """Survivor mask with the fastest kernel for the active backend."""
     if x.shape[1] <= 2:
@@ -248,6 +314,37 @@ def skyline_mask_auto(x, valid=None):
         return skyline_mask_pallas(x, valid)
     from skyline_tpu.ops.block_skyline import skyline_mask_scan
 
+    # d > 2 off-TPU: sorted-order SFS host cascade vs the scan kernel,
+    # chosen per (d, N, backend) from measured profiler wall data. Only
+    # for concrete arrays — under tracing (jit bodies, the jaxpr audit)
+    # the device kernel is the only sound choice.
+    mode = sorted_sfs_mode()
+    if mode != "off" and _is_concrete(x) and (valid is None or _is_concrete(valid)):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from skyline_tpu.ops.sorted_sfs import sorted_skyline_mask_np
+
+        n, d = x.shape
+        prof = _mask_profiler()
+        if mode == "on":
+            variant = "sorted_sfs_mask"
+        else:
+            variant = choose_variant(
+                prof, ("sorted_sfs_mask", "mask_scan"), d, n
+            )
+        if variant == "sorted_sfs_mask":
+            with prof.record("sorted_sfs_mask", d, n):
+                mask = sorted_skyline_mask_np(
+                    np.asarray(x),
+                    None if valid is None else np.asarray(valid),
+                )
+                out = jnp.asarray(mask)
+            return out
+        with prof.record("mask_scan", d, n):
+            out = skyline_mask_scan(x, valid)
+            out.block_until_ready()  # honest wall for the EMA compare
+        return out
     return skyline_mask_scan(x, valid)
 
 

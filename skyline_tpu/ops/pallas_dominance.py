@@ -5,7 +5,10 @@ Computes, for every point of a set, whether ANY valid point dominates it
 local flush and the global merge; the XLA version (`skyline_mask_scan`)
 materializes (chunk, N) bool tiles through HBM, while this kernel keeps the
 whole (R, C) comparison tile in VMEM and fuses the per-dimension compare
-cascade with the row-reduction.
+cascade with the row-reduction. Off-TPU, concrete (non-traced) d>2 calls
+may instead route to the host sorted cascade (``ops/sorted_sfs.py``) when
+its measured wall beats the scan — see ``dispatch.skyline_mask_auto``;
+this kernel remains the only d>2 path on TPU and inside jit.
 
 Layout: points are fed TRANSPOSED as ``(d, N)`` so each dimension's
 coordinates lie contiguous along lanes — the (R, C) broadcast compare then

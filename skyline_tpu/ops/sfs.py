@@ -13,6 +13,13 @@ These are pure device kernels (ops layer); the stateful streaming owner is
 ``stream.batched.PartitionSet`` (lazy flush policy), and the single-set
 library form is ``ops.block_skyline.skyline_large``.
 
+Host sibling: ``ops.sorted_sfs`` runs the same sum-sorted append scan in
+NumPy with a dedup front end and exact in-block tiles for the equal-sum
+band — byte-identical appends (same pre-sorted rows, same order, selection
+only). On non-TPU backends the lazy flush picks between these rounds and
+the host cascade per (d, N, backend) signature from measured profiler wall
+data (``dispatch.choose_variant``; RUNBOOK §2m).
+
 The jits donate the ``sky`` buffer so each append round updates the
 full-capacity buffer in place instead of copying it (64 MB/round at the
 north-star window; donation is a no-op with a warning on CPU, filtered in

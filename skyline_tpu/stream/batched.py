@@ -36,6 +36,7 @@ import numpy as np
 from skyline_tpu.metrics.tracing import NULL_TRACER
 from skyline_tpu.resilience.faults import fault_point
 from skyline_tpu.ops.dispatch import (
+    choose_variant,
     delta_dirty_cutoff,
     flush_prefilter_enabled,
     flush_stage_depth,
@@ -45,6 +46,7 @@ from skyline_tpu.ops.dispatch import (
     mixed_precision_enabled,
     on_tpu,
     profile_cost_enabled,
+    sorted_sfs_mode,
 )
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
@@ -304,6 +306,12 @@ class PartitionSet:
         # claims it onto the handle (and clears it) so annotation follows
         # the merge, not the PartitionSet
         self._explain = None
+        # flush-path chooser profiler: whole-flush wall per variant
+        # (flush_sorted_sfs vs flush_sfs_sequential/vmapped) under the
+        # (d, N, backend) signature — kept SEPARATE from self._profiler,
+        # whose per-round records these flush-level aggregates would
+        # double-count. Lazily created: the TPU/mesh paths never pay it.
+        self._flush_prof = None
         self.merge_cache_hits = 0
         self.merge_cache_misses = 0
         self.merge_delta_merges = 0
@@ -388,6 +396,13 @@ class PartitionSet:
         around already-timed regions — skyline bytes are unchanged."""
         self._profiler = profiler
         self._flight = flight
+        if profiler is not None:
+            # share with the dispatch-level chooser so host-path mask
+            # dispatches (sorted_sfs_mask vs mask_scan) land in /profile
+            # and the EXPLAIN kernel deltas too
+            from skyline_tpu.ops.dispatch import register_profiler
+
+            register_profiler(profiler)
 
     def set_explain(self, plan) -> None:
         """Park the current query's ``QueryPlan`` for the next
@@ -1274,11 +1289,31 @@ class PartitionSet:
         sequential = self.mesh is None and (
             self.num_partitions * max_rows > 2 * total_rows
         )
+        device_variant = "sequential" if sequential else "vmapped"
+        path = self._choose_lazy_path(device_variant, total_rows)
         self._fnote(
             "flush.dispatch", policy=self.flush_policy, rows=total_rows,
-            max_rows=max_rows, sequential=sequential,
+            max_rows=max_rows, sequential=sequential, path=path,
         )
-        if sequential:
+        if path == "sorted_sfs":
+            self._inc("flush.sorted_sfs")
+            with self._flush_prof.record(
+                "flush_sorted_sfs", self.dims, total_rows
+            ):
+                counts = self._sfs_sorted_host(rows)
+        elif self._flush_prof is not None:
+            # chooser active: time the device flush end to end (counts
+            # sync included) so the EMA compare is honest
+            with self._flush_prof.record(
+                "flush_sfs_" + device_variant, self.dims, total_rows
+            ):
+                counts = (
+                    self._sfs_sequential(rows)
+                    if sequential
+                    else self._sfs_vmapped(rows, max_rows)
+                )
+                np.asarray(counts)
+        elif sequential:
             counts = self._sfs_sequential(rows)
         else:
             counts = self._sfs_vmapped(rows, max_rows)
@@ -1289,6 +1324,82 @@ class PartitionSet:
             int(old_counts.max()) if had_old else 0,
             t0,
         )
+
+    def _choose_lazy_path(self, device_variant: str, total_rows: int) -> str:
+        """Pick the lazy-flush merge path: ``sorted_sfs`` (host cascade,
+        ops/sorted_sfs.py) or the device SFS variant. Per ISSUE 11 this is
+        a profiler-driven choice, not an env gate: under ``auto`` each
+        candidate's WHOLE-FLUSH wall is recorded once per (d, N-bucket,
+        backend) signature and the measured EMA decides thereafter
+        (``dispatch.choose_variant``; the sorted path explores first). The
+        host path needs concrete host rows, so meshes and TPU backends
+        always keep the device variant."""
+        if self.mesh is not None or on_tpu():
+            return device_variant
+        mode = sorted_sfs_mode()
+        if mode == "off":
+            return device_variant
+        if self._flush_prof is None:
+            from skyline_tpu.telemetry.profiler import KernelProfiler
+
+            self._flush_prof = KernelProfiler()
+        if mode == "on":
+            return "sorted_sfs"
+        chosen = choose_variant(
+            self._flush_prof,
+            ("flush_sorted_sfs", "flush_sfs_" + device_variant),
+            self.dims,
+            total_rows,
+        )
+        return "sorted_sfs" if chosen == "flush_sorted_sfs" else device_variant
+
+    def _sfs_sorted_host(self, rows: list[np.ndarray]):
+        """Host sorted-order SFS flush: per partition, take the exact
+        survivor mask of old ∪ new on the host (ops/sorted_sfs.py dedup +
+        sum-sorted scan) and append the surviving new rows after the old
+        prefix — the same rows in the same order the device SFS rounds
+        append (rows arrive pre-sorted by row sum from ``_flush_lazy``,
+        and the cascade only selects, never reorders), so every
+        downstream consumer sees byte-identical state; the shared
+        ``_finish_lazy_flush`` old-vs-new cleanup then runs unchanged.
+        Returns the device counts vector like its device siblings."""
+        from skyline_tpu.ops.sorted_sfs import sorted_sfs_keep
+
+        if not int(self._count_ub.max()):
+            counts_host = np.zeros(self.num_partitions, dtype=np.int64)
+        else:
+            counts_host = self.sky_counts().astype(np.int64)
+        new_skies = []
+        new_counts = []
+        for p in range(self.num_partitions):
+            rp = rows[p]
+            sky_p = self.sky[p]
+            cnt_p = self._count_dev[p]
+            old_n = int(counts_host[p])
+            if rp.shape[0]:
+                with self.tracer.phase("flush/assemble"):
+                    old = np.asarray(sky_p[:old_n]) if old_n else None
+                with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                    "sorted_sfs", old_n + rp.shape[0]
+                ):
+                    keep = sorted_sfs_keep(rp, old)
+                surv = rp[keep]
+                need = old_n + surv.shape[0]
+                cap_p = max(sky_p.shape[0], _next_pow2(max(need, 1)))
+                with self.tracer.phase("flush/assemble"):
+                    buf = np.full(
+                        (cap_p, self.dims), np.inf, dtype=np.float32
+                    )
+                    if old_n:
+                        buf[:old_n] = old
+                    buf[old_n:need] = surv
+                with self.tracer.phase("flush/device_put"):
+                    sky_p = jnp.asarray(buf)
+                    cnt_p = jnp.asarray(np.int32(need))
+                self._count_ub[p] = need
+            new_skies.append(sky_p)
+            new_counts.append(cnt_p)
+        return self._restack_skies(new_skies, new_counts)
 
     def _check_had_old(self):
         """Non-empty initial state needs exact old counts for the final

@@ -56,6 +56,16 @@ KERNEL_VARIANTS = {
     "sfs_sequential": "single-partition SFS flush round",
     "sfs_rank": "device-resident SFS round (per-rank / vmapped dw paths)",
     "sfs_cleanup": "lazy-flush cleanup pass",
+    "sorted_sfs": "host sorted-order SFS cascade, one partition's flush "
+                  "(ops/sorted_sfs.py: dedup + f64 sum-sort + blocked scan)",
+    # dispatch-chooser signatures (recorded into PartitionSet._flush_prof
+    # and dispatch._MASK_PROFILER, not the engine profiler — whole-path
+    # aggregates that would double-count the per-round rows above)
+    "flush_sorted_sfs": "whole lazy flush via the host sorted cascade",
+    "flush_sfs_sequential": "whole lazy flush via per-partition SFS rounds",
+    "flush_sfs_vmapped": "whole lazy flush via vmapped SFS rounds",
+    "sorted_sfs_mask": "skyline_mask_auto host path (concrete non-TPU d>2)",
+    "mask_scan": "skyline_mask_auto device scan kernel (concrete arrays)",
 }
 
 # Minimum buffer capacity. Power-of-two buckets >= this always divide the

@@ -155,6 +155,16 @@ class KernelProfiler:
                 out[k.replace(" ", "_")] = float(v)
         return out or None
 
+    def ema_ms(self, variant: str, d: int, n: int, mp: bool = False):
+        """EMA wall of one signature, or None if it never dispatched —
+        the read side of profiler-driven dispatch (``dispatch.
+        choose_variant`` compares candidate variants' measured EMAs under
+        the same (d, N-bucket, backend) instead of a hand-tuned gate)."""
+        key = (variant, int(d), n_bucket(n), self._backend_name(), bool(mp))
+        with self._lock:
+            e = self._entries.get(key)
+            return None if e is None else e.ema_ms
+
     def total_wall_ms(self) -> float:
         with self._lock:
             return sum(e.wall_ms for e in self._entries.values())

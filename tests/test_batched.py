@@ -148,7 +148,11 @@ def test_meshed_merge_pallas_interpret(rng, monkeypatch):
 def test_lazy_flush_path_choice(rng, monkeypatch):
     """The lazy flush picks per-partition sequential SFS under routing skew
     (P * max_rows > 2 * total_rows) and the one-launch-per-round vmapped SFS
-    for balanced loads — and both produce the oracle skyline either way."""
+    for balanced loads — and both produce the oracle skyline either way.
+    (Device-path heuristic only: the sorted host cascade is pinned off so
+    the chooser can't route around both variants — its own engagement is
+    covered by tests/test_sorted_sfs.py.)"""
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
     calls = []
     orig_seq = PartitionSet._sfs_sequential
     orig_vm = PartitionSet._sfs_vmapped

@@ -1,0 +1,279 @@
+"""Sorted-order SFS dominance cascade (ISSUE 11): the host cascade must
+be byte-identical to the device dominance kernels at every level it can
+be swapped in — raw mask, union keep, engine flush — plus agreement of
+the independent sorted audit oracle with the quadratic one, and the
+containment guarantee that the host path never leaks into a trace."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skyline_tpu.audit.oracle import oracle_fn, sorted_skyline_np
+from skyline_tpu.ops.dispatch import skyline_mask_auto, sorted_sfs_mode
+from skyline_tpu.ops.dominance import skyline_mask, skyline_np
+from skyline_tpu.ops.sorted_sfs import sorted_sfs_keep, sorted_skyline_mask_np
+from skyline_tpu.stream.batched import PartitionSet
+
+# shared via conftest.py
+from conftest import assert_same_merge, fill_pset, gen_points, merge_state
+
+# ---------------------------------------------------------------------------
+# mask-level parity: sorted cascade vs the traced device mask
+# ---------------------------------------------------------------------------
+
+
+def _device_mask(x, valid=None):
+    return np.asarray(skyline_mask(jnp.asarray(x), valid))
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+@pytest.mark.parametrize("d", [3, 4, 8])
+def test_mask_parity_grid(rng, kind, d):
+    x = gen_points(rng, 600, d, kind)
+    got = sorted_skyline_mask_np(x)
+    want = _device_mask(x)
+    assert np.array_equal(got, want), (kind, d)
+
+
+def test_mask_parity_with_valid(rng):
+    x = gen_points(rng, 400, 4, "uniform")
+    valid = rng.random(400) < 0.7
+    got = sorted_skyline_mask_np(x, valid)
+    want = _device_mask(x, jnp.asarray(valid))
+    assert np.array_equal(got, want)
+    assert not got[~valid].any()
+
+
+ADVERSARIAL = {
+    # every duplicate of a surviving tuple survives; none dominate each other
+    "duplicates": np.repeat(
+        np.array([[1, 9], [9, 1], [5, 5], [2, 8]], np.float32), 16, axis=0
+    ),
+    # the bench degenerate: a huge all-equal clump (equal row sums) plus a
+    # tail it dominates
+    "zero-clump": np.concatenate([
+        np.zeros((256, 4), np.float32),
+        np.full((32, 4), 3.0, np.float32),
+    ]),
+    # all rows share one row-sum but differ — the whole input is one
+    # ambiguous band, the sort key gives the scan nothing
+    "equal-sums": np.array(
+        [[0, 3], [1, 2], [2, 1], [3, 0], [1.5, 1.5]], np.float32
+    ).repeat(8, axis=0),
+    # NaN rows are dominance-neutral and always survive; inf rows are
+    # dominated by everything finite
+    "nan-inf": np.array(
+        [
+            [1, 1, 1],
+            [np.nan, 0, 0],
+            [np.inf, np.inf, np.inf],
+            [0, np.nan, np.nan],
+            [2, 2, 2],
+            [np.inf, 0, 0],
+        ],
+        np.float32,
+    ),
+    # mixed +/- inf rows have NaN row sums — the cascade's exact detour
+    "mixed-inf": np.array(
+        [
+            [np.inf, -np.inf, 0],
+            [-np.inf, np.inf, 0],
+            [-np.inf, -np.inf, -np.inf],
+            [0, 0, 0],
+            [np.inf, -np.inf, 1],
+        ],
+        np.float32,
+    ),
+    # -0.0 == 0.0 numerically but not as bytes — the dedup fold must not
+    # let the distinct-implies-strict shortcut kill either
+    "signed-zero": np.array(
+        [[-0.0, 0.0], [0.0, -0.0], [0.0, 0.0], [1.0, 1.0]], np.float32
+    ),
+    "single": np.array([[4, 2, 7]], np.float32),
+    "empty": np.zeros((0, 5), np.float32),
+}
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_mask_parity_adversarial(case):
+    x = ADVERSARIAL[case]
+    got = sorted_skyline_mask_np(x)
+    want = _device_mask(x)
+    assert np.array_equal(got, want), case
+    # identity must hold byte-for-byte on the selected rows too
+    assert x[got].tobytes() == x[want].tobytes(), case
+
+
+def test_signed_zero_rows_survive_unfolded():
+    """The -0.0 fold is selection-only: the surviving rows keep their
+    original sign bits."""
+    x = np.array([[-0.0, 0.0], [1.0, 1.0]], np.float32)
+    keep = sorted_skyline_mask_np(x)
+    assert keep[0]
+    assert x[keep].tobytes() == x[:1].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# union keep: the flush-path primitive
+# ---------------------------------------------------------------------------
+
+
+def test_keep_union_semantics(rng):
+    """sorted_sfs_keep(rows, old) == survivors of old ∪ rows restricted
+    to rows — the exact contract the flush append rides on."""
+    for d in (3, 6):
+        old = gen_points(rng, 200, d, "anti")
+        old = old[sorted_skyline_mask_np(old)]  # a real skyline prefix
+        rows = gen_points(rng, 300, d, "uniform")
+        keep = sorted_sfs_keep(rows, old)
+        union = np.concatenate([old, rows])
+        want = _device_mask(union)[old.shape[0]:]
+        assert np.array_equal(keep, want), d
+
+
+def test_keep_no_old(rng):
+    rows = gen_points(rng, 150, 4, "uniform")
+    assert np.array_equal(sorted_sfs_keep(rows), sorted_skyline_mask_np(rows))
+
+
+def test_keep_duplicate_of_old_survives():
+    old = np.array([[1, 1]], np.float32)
+    rows = np.array([[1, 1], [2, 2]], np.float32)
+    keep = sorted_sfs_keep(rows, old)
+    assert keep[0] and not keep[1]
+
+
+# ---------------------------------------------------------------------------
+# engine-level byte identity: sorted cascade on vs off through the flush
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["uniform", "anti"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+@pytest.mark.parametrize("policy", ["incremental", "lazy", "overlap"])
+def test_engine_byte_identity(monkeypatch, kind, d, policy):
+    """The knob must never change a published byte: global merge digest
+    (count, survivor vector, point bytes) identical across off/on/auto.
+    d=2 never routes to the cascade — included to prove the gate is
+    inert there too."""
+    states = {}
+    for mode in ("off", "on", "auto"):
+        monkeypatch.setenv("SKYLINE_SORTED_SFS", mode)
+        rng = np.random.default_rng(37)
+        pset = PartitionSet(3, d, flush_policy=policy)
+        fill_pset(pset, rng, gen_points(rng, 512, d, kind), 3)
+        states[mode] = merge_state(pset)
+    assert_same_merge(states["off"], states["on"], f"{kind}/{d}/{policy}")
+    assert_same_merge(states["off"], states["auto"], f"{kind}/{d}/{policy}")
+
+
+def test_engine_flush_counter(monkeypatch):
+    """Forced on, a d>2 lazy flush must actually take the sorted path
+    (flush.sorted_sfs counter) — guards against the gate silently never
+    engaging."""
+    from skyline_tpu.telemetry import Telemetry
+
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "on")
+    tel = Telemetry()
+    rng = np.random.default_rng(5)
+    pset = PartitionSet(2, 4, flush_policy="lazy", counters=tel.counters)
+    fill_pset(pset, rng, gen_points(rng, 400, 4, "anti"), 2)
+    counters = dict(tel.counters.snapshot())
+    assert counters.get("flush.sorted_sfs", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch gate + trace containment
+# ---------------------------------------------------------------------------
+
+
+def test_mode_knob(monkeypatch):
+    monkeypatch.delenv("SKYLINE_SORTED_SFS", raising=False)
+    assert sorted_sfs_mode() == "auto"
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    assert sorted_sfs_mode() == "off"
+
+
+def test_dispatch_forced_on_matches_off(monkeypatch, rng):
+    x = jnp.asarray(gen_points(rng, 300, 5, "anti"))
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    off = np.asarray(skyline_mask_auto(x))
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "on")
+    on = np.asarray(skyline_mask_auto(x))
+    assert np.array_equal(off, on)
+
+
+def test_trace_containment(monkeypatch, rng):
+    """Under jit the inputs are tracers: even forced on, the host cascade
+    must step aside and the traced result must match the host one."""
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "on")
+    x = jnp.asarray(gen_points(rng, 200, 4, "uniform"))
+    jitted = jax.jit(skyline_mask_auto)
+    got = np.asarray(jitted(x))
+    want = sorted_skyline_mask_np(np.asarray(x))
+    assert np.array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# audit oracle: independent sorted scan vs the quadratic referee
+# ---------------------------------------------------------------------------
+
+
+def _canon(rows):
+    rows = np.asarray(rows, np.float32)
+    if rows.shape[0] == 0:
+        return rows
+    return rows[np.lexsort(rows.T[::-1])]
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_oracle_agreement_grid(rng, kind, d):
+    x = gen_points(rng, 700, d, kind)
+    a = _canon(sorted_skyline_np(x))
+    b = _canon(skyline_np(x))
+    assert a.shape == b.shape and a.tobytes() == b.tobytes(), (kind, d)
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_oracle_agreement_adversarial(case):
+    x = ADVERSARIAL[case]
+    a = _canon(sorted_skyline_np(x))
+    b = _canon(skyline_np(x))
+    assert a.shape == b.shape, case
+    # NaN != NaN, so compare as bytes after canonical ordering
+    assert a.tobytes() == b.tobytes(), case
+
+
+def test_oracle_knob_selects(monkeypatch):
+    monkeypatch.setenv("SKYLINE_AUDIT_ORACLE", "quadratic")
+    assert oracle_fn() is skyline_np
+    monkeypatch.setenv("SKYLINE_AUDIT_ORACLE", "sorted")
+    assert oracle_fn() is sorted_skyline_np
+
+
+def test_audit_check_with_sorted_oracle(monkeypatch):
+    """End to end: a settled engine passes a full audit check under the
+    sorted oracle, and the record says which oracle vouched."""
+    from skyline_tpu.serve import SnapshotStore
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.telemetry import Telemetry
+
+    monkeypatch.setenv("SKYLINE_AUDIT", "1")
+    monkeypatch.setenv("SKYLINE_AUDIT_SAMPLE", "1.0")
+    monkeypatch.setenv("SKYLINE_AUDIT_ORACLE", "sorted")
+    rng = np.random.default_rng(3)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, dims=4, domain_max=1.0,
+                     buffer_size=512, emit_skyline_points=True),
+        telemetry=Telemetry(),
+    )
+    eng.attach_snapshots(SnapshotStore())
+    x = gen_points(rng, 1500, 4, "anti")
+    eng.process_records(np.arange(x.shape[0], dtype=np.int64), x)
+    eng.process_trigger("q,0")
+    eng.poll_results()
+    rec = eng.auditor.check()
+    assert rec is not None and rec["ok"], rec
+    assert rec["oracle"] == "sorted"
