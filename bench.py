@@ -234,6 +234,61 @@ def sorted_sfs_leg(cfg, ids, x, required) -> dict:
     return block
 
 
+def sharded_leg(cfg, ids, x, required) -> dict:
+    """Sharded-engine truth for the bench artifact (ISSUE 12): one
+    ``ShardedEngine`` over the bench window — trigger twice (cold
+    two-level tournament, then facade epoch-cache hit) — stamping chip
+    count, group size, merge/cache counters, and the window's own
+    ``window_pruned_chip_fraction`` (≈0 on anti-correlated data, where
+    every chip contributes to the front). A small fully-skewed prune
+    probe then exercises the chip-witness prefilter so the
+    ``pruned_chip_fraction`` that ``scripts/bench_compare.py`` gates on
+    is non-trivial; the identity-asserting latency A/B lives in
+    ``benchmarks/sharded_engine.py`` (artifacts/sharded_engine_ab.json)."""
+    import dataclasses
+
+    from skyline_tpu.distributed import ShardedEngine, ShardedPartitionSet
+
+    scfg = cfg
+    if getattr(cfg, "ingest", "host") == "device":
+        # the sharded facade is host-merge only (each chip owns its own
+        # ingest routing), so this leg always measures the host path
+        scfg = dataclasses.replace(cfg, ingest="host")
+    chips = 2 if scfg.parallelism % 2 == 0 else 1
+    eng = ShardedEngine(scfg, chips=chips)
+    n = x.shape[0]
+    chunk = 65536
+    for i in range(0, n, chunk):
+        eng.process_records(ids[i : i + chunk], x[i : i + chunk])
+    for _ in range(2):  # cold tournament, then facade epoch-cache hit
+        eng.process_trigger(f"0,{required}")
+        eng.poll_results()
+    block = dict(eng.stats().get("sharded", {}))
+    block["window_pruned_chip_fraction"] = block.pop(
+        "pruned_chip_fraction", 0.0
+    )
+    # prune probe: chip 0 owns an origin cluster, every other chip only
+    # dominated upper-region rows, so chip 0's witness skips them all
+    Pp, probe_chips = 8, 4
+    sp = ShardedPartitionSet(Pp, scfg.dims, 4096, chips=probe_chips)
+    rng = np.random.default_rng(7)
+    lo = (rng.random((64, scfg.dims)) * 40.0 + 1.0).astype(np.float32)
+    hi = (rng.random((256, scfg.dims)) * 400.0 + 9000.0).astype(np.float32)
+    sp.add_batch(0, lo, max_id=1 << 20, now_ms=0.0)
+    for p in range(1, Pp):
+        sp.add_batch(p, hi, max_id=1 << 20, now_ms=0.0)
+    sp.flush_all()
+    sp.global_merge_stats(emit_points=True)
+    pst = sp.sharded_stats()
+    block["prune_probe"] = {
+        "chips": probe_chips,
+        "chips_pruned": pst["chips_pruned"],
+        "chips_considered": pst["chips_considered"],
+    }
+    block["pruned_chip_fraction"] = pst["pruned_chip_fraction"]
+    return block
+
+
 def serve_leg(d: int, algo: str) -> dict:
     """Serving-plane microbenchmark: read latency p50/p99 and shed rate.
 
@@ -519,6 +574,12 @@ def child_main(backend: str) -> None:
     except Exception as e:  # pragma: no cover - diagnostic path
         sorted_sfs = {"error": f"{type(e).__name__}: {e}"}
     try:
+        sharded = sharded_leg(
+            cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
+        )
+    except Exception as e:  # pragma: no cover - diagnostic path
+        sharded = {"error": f"{type(e).__name__}: {e}"}
+    try:
         analysis = analysis_stamp()
     except Exception as e:  # pragma: no cover - diagnostic path
         analysis = {"error": f"{type(e).__name__}: {e}"}
@@ -555,6 +616,7 @@ def child_main(backend: str) -> None:
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,
+                "sharded": sharded,
                 "freshness": freshness,
                 "kernel_profile": kernel_profile,
                 "explain": explain,

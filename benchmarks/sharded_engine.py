@@ -1,0 +1,249 @@
+"""A/B: single-device flat engine vs the sharded engine's two-level
+tournament merge at 1 vs N chips (ISSUE 12 tentpole).
+
+For each (n, d) at P partitions, feeds IDENTICAL streams (same routing,
+same chunking, same flush cadence) to one single-device ``PartitionSet``
+and one ``ShardedPartitionSet`` per chip count, asserts the global
+merges byte-identical (rows AND order) BEFORE any timing, then times:
+
+- ``single_ms``:   flat single-device full merge (the baseline)
+- ``chips_<C>_ms``: the two-level tournament at C chips — intra-chip
+  pruned trees, chip-witness prefilter, cross-chip pairwise merge
+
+The prune leg repeats the N-chip measurement over a skewed stream
+(one chip owns the origin cluster) so ``pruned_chip_fraction`` is
+non-trivial — the number ``scripts/bench_compare.py`` gates on.
+
+On CPU the chips are XLA host-platform virtual devices
+(``--xla_force_host_platform_device_count``), so the interconnect win
+is not visible — the point here is identity + bookkeeping; the TPU run
+measures the actual cross-chip traffic saved.
+
+Writes ``artifacts/sharded_engine_ab.json``.
+
+Usage: python benchmarks/sharded_engine.py [--repeats 5] [--chips 2 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from skyline_tpu.analysis.registry import env_str
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _timed(fn, repeats: int) -> float:
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1000.0)
+
+
+def _feed(pset, x: np.ndarray, P: int, skew_chip0: bool) -> None:
+    """Identical ingest for every engine under test: deterministic
+    round-robin routing, chunked adds, the engine's own flush cadence."""
+    n = x.shape[0]
+    pids = np.arange(n) % P
+    for lo in range(0, n, 4096):
+        hi = min(lo + 4096, n)
+        for p in range(P):
+            rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+            if rows.shape[0]:
+                pset.add_batch(p, rows, max_id=n, now_ms=0.0)
+        pset.maybe_flush()
+    pset.flush_all()
+
+
+def _stream(n: int, d: int, P: int, skew: bool) -> np.ndarray:
+    rng = np.random.default_rng(3)
+    if not skew:
+        from skyline_tpu.workload.generators import anti_correlated
+
+        return anti_correlated(rng, n, d, 0, 10000).astype(np.float32)
+    # skewed: partition 0's rows cluster near the origin, the rest live in
+    # the dominated upper region — the chip-prune prefilter's best case
+    x = (rng.random((n, d)) * 4000.0 + 5500.0).astype(np.float32)
+    x[::P] = (rng.random((len(x[::P]), d)) * 400.0 + 100.0).astype(
+        np.float32
+    )
+    return x
+
+
+def bench_one(n: int, d: int, P: int, chips_list: list[int],
+              repeats: int) -> dict:
+    from skyline_tpu.distributed import ShardedPartitionSet
+    from skyline_tpu.stream.batched import PartitionSet
+
+    def dirty_round(pset):
+        # repeated merges over unchanged state would hit the epoch cache
+        # and time nothing; dirty one partition so every timed merge is a
+        # real full pass, identically on both sides
+        rng = np.random.default_rng(4)
+
+        def one():
+            pset.add_batch(
+                P - 1,
+                (rng.random((64, d)) * 400.0 + 9000.0).astype(np.float32),
+                max_id=n,
+                now_ms=0.0,
+            )
+            pset.flush_all()
+            pset.global_merge_stats(emit_points=True)
+
+        return one
+
+    x = _stream(n, d, P, skew=False)
+    single = PartitionSet(P, d, buffer_size=max(n, 1024))
+    _feed(single, x, P, skew_chip0=False)
+    ref = single.global_merge_stats(emit_points=True)  # warm + reference
+    single_ms = _timed(dirty_round(single), repeats)
+
+    row = {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "skyline_size": int(ref[2]),
+        "single_ms": round(single_ms, 2),
+        "chips": {},
+    }
+    for chips in chips_list:
+        sp = ShardedPartitionSet(P, d, max(n, 1024), chips=chips)
+        _feed(sp, x, P, skew_chip0=False)
+        res = sp.global_merge_stats(emit_points=True)  # warm
+        # byte-identity BEFORE timing: a fast wrong answer is worthless
+        assert res[2] == ref[2], (res[2], ref[2])
+        assert np.asarray(res[0]).tobytes() == np.asarray(ref[0]).tobytes()
+        assert res[3].tobytes() == ref[3].tobytes(), (
+            f"sharded diverges from single-device at n={n} d={d} "
+            f"chips={chips}"
+        )
+        ms = _timed(dirty_round(sp), repeats)
+        st = sp.sharded_stats()
+        row["chips"][str(chips)] = {
+            "merge_ms": round(ms, 2),
+            "speedup": round(single_ms / ms, 2) if ms else None,
+            "pruned_chip_fraction": st["pruned_chip_fraction"],
+        }
+    return row
+
+
+def bench_prune(n: int, d: int, P: int, chips: int, repeats: int) -> dict:
+    """The chip-witness prefilter leg: a skewed stream where one chip's
+    witness dominates every other chip, so the cross-chip merge touches
+    one chip-local skyline instead of ``chips``."""
+    from skyline_tpu.distributed import ShardedPartitionSet
+    from skyline_tpu.stream.batched import PartitionSet
+
+    x = _stream(n, d, P, skew=True)
+    single = PartitionSet(P, d, buffer_size=max(n, 1024))
+    _feed(single, x, P, skew_chip0=True)
+    ref = single.global_merge_stats(emit_points=True)
+
+    def run(prune_on: bool):
+        os.environ["SKYLINE_CHIP_PRUNE"] = "1" if prune_on else "0"
+        sp = ShardedPartitionSet(P, d, max(n, 1024), chips=chips)
+        _feed(sp, x, P, skew_chip0=True)
+        res = sp.global_merge_stats(emit_points=True)  # warm
+        assert res[2] == ref[2], (res[2], ref[2])
+        assert res[3].tobytes() == ref[3].tobytes(), (
+            f"chip-pruned merge diverges at n={n} d={d} chips={chips} "
+            f"prune={prune_on}"
+        )
+        # dirty one partition per repeat so every timed merge is a real
+        # two-level pass (unchanged state would hit the facade cache)
+        def one():
+            sp.add_batch(
+                P - 1,
+                (np.random.default_rng(4).random((64, d)) * 400.0
+                 + 9000.0).astype(np.float32),
+                max_id=n,
+                now_ms=0.0,
+            )
+            sp.flush_all()
+            sp.global_merge_stats(emit_points=True)
+
+        ms = _timed(one, repeats)
+        return sp, ms
+
+    sp_off, off_ms = run(prune_on=False)
+    sp_on, on_ms = run(prune_on=True)
+    st = sp_on.sharded_stats()
+    return {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "chips": chips,
+        "skyline_size": int(ref[2]),
+        "prune_off_ms": round(off_ms, 2),
+        "prune_on_ms": round(on_ms, 2),
+        "prune_speedup": round(off_ms / on_ms, 2) if on_ms else None,
+        "chips_pruned": st["chips_pruned"],
+        "pruned_chip_fraction": st["pruned_chip_fraction"],
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--sizes", type=int, nargs="+", default=[65536, 262144])
+    ap.add_argument("--dims", type=int, nargs="+", default=[8])
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--chips", type=int, nargs="+", default=[2, 4])
+    ap.add_argument("--out", default="artifacts/sharded_engine_ab.json")
+    a = ap.parse_args(argv)
+
+    import jax
+
+    # belt and braces (same as run_configs.py): pin the backend for real
+    if env_str("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    for chips in a.chips:
+        if a.partitions % chips:
+            raise SystemExit(
+                f"partitions {a.partitions} not divisible by chips {chips}"
+            )
+
+    prev = os.environ.get("SKYLINE_CHIP_PRUNE")  # lint: allow-raw-env
+    results = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "device_count": jax.device_count(),
+        "rows": [],
+        "prune_rows": [],
+    }
+    try:
+        for n in a.sizes:
+            for d in a.dims:
+                row = bench_one(n, d, a.partitions, a.chips, a.repeats)
+                print(json.dumps(row), flush=True)
+                results["rows"].append(row)
+                prow = bench_prune(
+                    n, d, a.partitions, max(a.chips), a.repeats
+                )
+                print(json.dumps(prow), flush=True)
+                results["prune_rows"].append(prow)
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLINE_CHIP_PRUNE", None)
+        else:
+            os.environ["SKYLINE_CHIP_PRUNE"] = prev
+    if a.out:
+        os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
+        with open(a.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -74,6 +74,12 @@ METRICS = (
     # prefilter stopped dropping partitions (dead summaries / gating bug)
     ("merge_tree.pruned_fraction", ("merge_tree", "pruned_fraction"),
      True, False),
+    # sharded-engine leg (bench.py sharded_leg): the skewed prune probe's
+    # chip-witness prefilter fraction — a drop means whole-chip pruning in
+    # the cross-chip tournament went dead (stale chip summaries / knob
+    # regression); absent (pre-sharded artifacts) skips, never fails
+    ("sharded.pruned_chip_fraction", ("sharded", "pruned_chip_fraction"),
+     True, False),
     # flush-cascade leg: the grid prefilter's drop fraction going to ~0
     # means the quantized summaries stopped certifying drops (stale grid /
     # validation disabling every dim / gating bug) — deterministic on any

@@ -429,6 +429,11 @@ if python scripts/bench_compare.py --dir "$REGRESSED"; then
 fi
 echo "[obs-smoke] bench_compare gate ok (pass + forced-regression trip)"
 
+# sharded-engine gate: the two-level chip tournament lands byte-identical
+# to the flat worker and the chip-witness prefilter is live (RUNBOOK 2n)
+scripts/mesh_smoke.sh
+echo "[obs-smoke] mesh gate ok"
+
 # crash-safety gate: supervised crash/restart cycle lands byte-identical
 # to an uninterrupted run, resilience counters move (RUNBOOK 2i)
 scripts/chaos_smoke.sh

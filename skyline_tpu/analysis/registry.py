@@ -104,6 +104,15 @@ KNOBS: tuple[Knob, ...] = (
        "bf16 margin pass inside the flush dominance kernels; unset = auto "
        "(on for TPU, off elsewhere — XLA CPU emulates bf16)", "engine",
        runbook="§2g"),
+    _k("SKYLINE_CHIP_PRUNE", "bool", True,
+       "chip-level witness prefilter in the sharded engine's two-level "
+       "merge (a dominated chip never crosses the interconnect)",
+       "engine/sharded", runbook="§2n"),
+    _k("SKYLINE_CHIP_BARRIER", "enum", "merge",
+       "when the sharded engine writes chip-consistency barrier records: "
+       "merge (every two-level merge), checkpoint (checkpoint time only), "
+       "off (no chip WAL plane)", "engine/sharded",
+       choices=("merge", "checkpoint", "off"), runbook="§2n"),
     _k("SKYLINE_QUERY_OVERLAP", "bool", True,
        "overlapped query sync: launch the global merge at trigger time, "
        "harvest at emission", "engine", runbook="§2f"),
@@ -192,6 +201,10 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_MESH", "int", 0,
        "shard partitions over this many devices (0 = single device)",
        "job flag", job_field="mesh"),
+    _k("SKYLINE_MESH_CHIPS", "int", 0,
+       "sharded streaming engine: split partitions into this many per-chip "
+       "groups with a two-level tournament merge (0 = single device)",
+       "job flag", runbook="§2n", job_field="mesh_chips"),
     _k("SKYLINE_STATS_PORT", "int", 0,
        "serve live /stats JSON on this port (0 = off)", "job flag",
        runbook="§2b", job_field="stats_port"),

@@ -33,6 +33,7 @@ class SkylineWorker:
         query_topic: str = QUERY_TOPIC,
         output_topic: str = OUTPUT_TOPIC,
         mesh=None,
+        mesh_chips: int = 0,
         stats_port: int | None = None,
         window_size: int = 0,
         slide: int = 0,
@@ -48,7 +49,12 @@ class SkylineWorker:
         resilience=None,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
-        across its devices (multi-chip streaming). ``stats_port``: serve
+        across its devices (multi-chip streaming). ``mesh_chips``: > 0
+        runs the sharded streaming engine (``distributed/``) — the
+        partition set splits into that many per-chip groups and queries
+        are answered by the two-level tournament merge; byte-identical
+        results, mutually exclusive with ``mesh`` and sliding-window
+        mode (RUNBOOK §2n). ``stats_port``: serve
         live /stats + /healthz JSON on this port (0 picks a free one; None
         disables) — the Flink-Web-UI role for this stack. ``window_size`` +
         ``slide`` (both > 0) switch the worker to the sliding-window engine
@@ -91,6 +97,13 @@ class SkylineWorker:
         from skyline_tpu.metrics.tracing import Tracer
         from skyline_tpu.telemetry import Telemetry
 
+        if mesh_chips and mesh is not None:
+            raise ValueError("mesh and mesh_chips are mutually exclusive")
+        if mesh_chips and window_size:
+            raise ValueError(
+                "sliding-window mode does not support mesh_chips"
+            )
+        self.mesh_chips = int(mesh_chips)
         self.bus = bus
         self.max_drain_polls = max_drain_polls
         self.tracer = tracer if tracer is not None else Tracer(sync_device=False)
@@ -111,6 +124,7 @@ class SkylineWorker:
         self.resilience = resilience
         self._ckpt_mgr = None
         self._wal = None
+        self._chip_wal = None
         self._snap_store = None
         self._serve_ring = None
         self._data_pos = 0  # consumed data-topic records (replay currency)
@@ -140,13 +154,21 @@ class SkylineWorker:
                 telemetry=self.telemetry,
             )
             hit = self._ckpt_mgr.restore_latest(
-                mesh=mesh, tracer=self.tracer, telemetry=self.telemetry
+                mesh=mesh, mesh_chips=mesh_chips, tracer=self.tracer,
+                telemetry=self.telemetry,
             )
             ckpt_path = None
             if hit is not None:
                 restored_engine, restored_meta, ckpt_path = hit
             self._wal_dir = os.path.join(resilience.checkpoint_dir, WAL_SUBDIR)
             wal_records, wal_torn = read_records(self._wal_dir)
+            # sharded group-consistency check: at the highest barrier seq
+            # common to all chip journals, every chip must agree on the
+            # global epoch digest; divergence raises WalReplayError here,
+            # BEFORE any replay could publish from inconsistent groups
+            from skyline_tpu.resilience.chip_wal import verify_chip_barriers
+
+            chip_verdict = verify_chip_barriers(self._wal_dir)
             if hit is not None or wal_records:
                 self._recovered = {
                     "checkpoint": ckpt_path,
@@ -154,6 +176,8 @@ class SkylineWorker:
                     "wal_torn_segments": wal_torn,
                     "replayed_batches": 0,
                 }
+                if chip_verdict["chips"]:
+                    self._recovered["chip_barriers"] = chip_verdict
         if window_size:
             from skyline_tpu.stream.sliding_engine import SlidingEngine
 
@@ -171,6 +195,13 @@ class SkylineWorker:
             # passed config so a restarted incarnation can't silently change
             # result semantics mid-stream
             self.engine = restored_engine
+        elif mesh_chips:
+            from skyline_tpu.distributed import ShardedEngine
+
+            self.engine = ShardedEngine(
+                config, chips=mesh_chips, tracer=self.tracer,
+                telemetry=self.telemetry,
+            )
         else:
             self.engine = SkylineEngine(
                 config, mesh=mesh, tracer=self.tracer, telemetry=self.telemetry
@@ -234,6 +265,25 @@ class SkylineWorker:
                 fsync=resilience.wal_fsync,
                 telemetry=self.telemetry,
             )
+            # chip-local WAL segments for the sharded engine: per-chip
+            # flush lineage + merge-time consistency barriers (policy
+            # "merge", the default), or checkpoint-time barriers only
+            # ("checkpoint"); "off" skips the plane entirely
+            if self.mesh_chips:
+                from skyline_tpu.ops.dispatch import chip_barrier_policy
+                from skyline_tpu.resilience.chip_wal import ChipWalPlane
+
+                policy = chip_barrier_policy()
+                if policy != "off":
+                    self._chip_wal = ChipWalPlane(
+                        self._wal_dir,
+                        self.mesh_chips,
+                        segment_bytes=resilience.wal_segment_bytes,
+                        fsync=resilience.wal_fsync,
+                        telemetry=self.telemetry,
+                    )
+                    if policy == "merge":
+                        self.engine.pset.attach_chip_wal(self._chip_wal)
             # subscribe AFTER the serve restore so re-seating the head never
             # logs a bogus everything-entered delta
             if self._snap_store is not None:
@@ -290,6 +340,8 @@ class SkylineWorker:
             }
             if self._wal is not None:
                 res["wal"] = self._wal.stats()
+            if self._chip_wal is not None:
+                res["chip_wal"] = self._chip_wal.stats()
             if self._recovered is not None:
                 res["recovered"] = self._recovered
             out["resilience"] = res
@@ -322,6 +374,12 @@ class SkylineWorker:
             except OSError:
                 pass
             self._wal = None
+        if self._chip_wal is not None:
+            try:
+                self._chip_wal.close()
+            except OSError:
+                pass
+            self._chip_wal = None
 
     # -- crash recovery ----------------------------------------------------
 
@@ -579,6 +637,17 @@ class SkylineWorker:
         )
         if self._wal is not None:
             self._wal.barrier(self._barrier_record())
+        if self._chip_wal is not None:
+            # the chip journals rotate with the main WAL (the checkpoint
+            # supersedes older segments); the snap blob stays in the main
+            # WAL only — chip journals carry positions, not rows
+            self._chip_wal.checkpoint_barrier(
+                {
+                    "type": "ckpt",
+                    "data_off": self._data_pos,
+                    "query_off": self._query_pos,
+                }
+            )
         self._last_ckpt_s = time.monotonic()
         self._dirty = False
         return path
@@ -891,6 +960,7 @@ def main(argv=None):
         query_topic=cfg.query_topic,
         output_topic=cfg.output_topic,
         mesh=cfg.build_mesh(),
+        mesh_chips=cfg.mesh_chips,
         stats_port=cfg.stats_port if cfg.stats_port > 0 else None,
         window_size=cfg.window_size,
         slide=cfg.slide,
@@ -906,6 +976,7 @@ def main(argv=None):
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
         f"dims={cfg.dims} broker={cfg.bootstrap} mesh={cfg.mesh or 'off'}"
+        f" chips={cfg.mesh_chips or 'off'}"
         + (f" stats=:{worker.stats_server.port}" if worker.stats_server else "")
         + (f" serve=:{worker.serve_server.port}" if worker.serve_server else "")
         + (f" checkpoints={cfg.checkpoint_dir}" if cfg.checkpoint_dir else ""),

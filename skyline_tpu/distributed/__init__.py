@@ -1,0 +1,6 @@
+"""Sharded streaming engine (ISSUE 12): per-chip partition groups with a
+two-level tournament merge. See ``distributed/sharded.py``."""
+
+from skyline_tpu.distributed.sharded import ShardedEngine, ShardedPartitionSet
+
+__all__ = ["ShardedEngine", "ShardedPartitionSet"]

@@ -105,6 +105,38 @@ def merge_prune_enabled() -> bool:
     return env_bool("SKYLINE_MERGE_PRUNE", True)
 
 
+def chip_prune_enabled() -> bool:
+    """``SKYLINE_CHIP_PRUNE`` gates the CHIP-level witness prefilter in the
+    sharded engine's two-level merge (``distributed/sharded.py``): each
+    chip-local tournament root is summarized as one
+    ``[min_corner | witness | sums]`` row and a chip whose min-corner is
+    strictly dominated by another chip's witness point is skipped before
+    any cross-chip transfer — whole device results never cross the
+    interconnect. The soundness argument is the partition prune's
+    (``merge_prune_enabled``) applied one level up, so the published bytes
+    are identical either way. Default ON; set ``0`` to gather every
+    non-empty chip (the A/B baseline benchmarks/sharded_engine.py and
+    scripts/mesh_smoke.sh compare against). Read lazily per query."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_CHIP_PRUNE", True)
+
+
+def chip_barrier_policy() -> str:
+    """``SKYLINE_CHIP_BARRIER`` picks when the sharded engine writes its
+    chip-consistency barrier records (``resilience/chip_wal.py``):
+    ``merge`` (default) stamps every completed two-level merge with each
+    chip's epoch digest so crash replay can verify all groups reconstruct
+    the same global state; ``checkpoint`` writes barriers only at
+    checkpoint time (fewer records, coarser replay verification);
+    ``off`` disables the chip WAL plane entirely. Read lazily per
+    attach/harvest."""
+    from skyline_tpu.analysis.registry import env_str
+
+    v = env_str("SKYLINE_CHIP_BARRIER", "merge")
+    return v if v in ("merge", "checkpoint", "off") else "merge"
+
+
 def flush_prefilter_enabled() -> bool:
     """``SKYLINE_FLUSH_PREFILTER`` gates the quantized grid prefilter ahead
     of the flush merge path (``stream/batched.py``): each partition keeps a

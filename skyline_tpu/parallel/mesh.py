@@ -30,6 +30,7 @@ from skyline_tpu.ops.block_skyline import (
     dominated_by_blocked,
     skyline_mask_blocked,
 )
+from skyline_tpu.utils.jax_compat import shard_map
 
 AXIS = "p"
 
@@ -92,7 +93,7 @@ def build_two_phase(
 
         return step
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),

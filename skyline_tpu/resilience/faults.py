@@ -33,6 +33,7 @@ KILL_POINTS = frozenset(
         "snapshot.publish",  # serve/snapshot.py publish entry
         "kafka.poll",  # bridge/worker.py step() poll entry
         "audit.corrupt",  # serve/snapshot.py publish body byte-flip
+        "sharded.chip_merge",  # distributed/sharded.py per-chip merge entry
     )
 )
 

@@ -2178,6 +2178,32 @@ class PartitionSet:
             self._count_dev, root_pids, root_cnt, self.num_partitions
         )
 
+    def merge_points_device(self, handle: _MergeHandle, out_cap: int):
+        """Device buffer of a HARVESTED merge's global skyline points,
+        ``(out_cap, d)`` with rows past the true count +inf-padded — no
+        host transfer. The sharded engine's cross-chip tournament feeds
+        each chip-local root straight into ``tree_pair_merge`` through
+        this, so chip results never round-trip through host memory.
+
+        Valid only between a harvest and the next flush (the caller holds
+        the chip's epoch fixed across the two-level merge). Prefers the
+        cache-plane buffer when it describes the handle's epoch; otherwise
+        compacts the handle's in-flight tree/flat result."""
+        h = handle
+        cache = self._gm_cache
+        if cache is not None and cache["key"] == h.key:
+            pts = cache["pts_dev"]
+            if pts.shape[0] >= out_cap:
+                return pts[:out_cap]
+            return jnp.pad(
+                pts,
+                ((0, out_cap - pts.shape[0]), (0, 0)),
+                constant_values=jnp.inf,
+            )
+        if h.root_vals is not None:
+            return tree_points_device(h.root_vals, out_cap)
+        return global_points_device(h.union, h.keep, out_cap)
+
     def _cached_points(self) -> np.ndarray:
         """Host copy of the cached global skyline points, transferred at
         most once per cached merge (later hits reuse the host array)."""

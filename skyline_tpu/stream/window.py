@@ -38,6 +38,7 @@ from skyline_tpu.ops.sfs import (  # noqa: F401  (re-exported: the SFS
     sfs_round_single,
 )
 from skyline_tpu.utils.buckets import next_pow2
+from skyline_tpu.utils.jax_compat import shard_map
 
 # Reference flushes its input buffer at 5000 tuples (BUFFER_SIZE,
 # FlinkSkyline.java:232); we default to the nearest power of two.
@@ -705,7 +706,7 @@ def _shard_map_vmapped(mesh, axis, fn, n_in: int, n_out: int, donate=()):
     from jax.sharding import PartitionSpec
 
     spec = PartitionSpec(axis)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         jax.vmap(fn),
         mesh=mesh,
         in_specs=(spec,) * n_in,

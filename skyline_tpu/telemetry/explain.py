@@ -48,8 +48,8 @@ class QueryPlan:
     """
 
     __slots__ = (
-        "trace_id", "query_id", "merge", "tree", "cascade", "kernels",
-        "publish", "timing",
+        "trace_id", "query_id", "merge", "tree", "chips", "cascade",
+        "kernels", "publish", "timing",
     )
 
     def __init__(self, trace_id: str | None, query_id: str):
@@ -57,6 +57,7 @@ class QueryPlan:
         self.query_id = query_id
         self.merge: dict | None = None
         self.tree: dict | None = None
+        self.chips: dict | None = None  # sharded engine only
         self.cascade: dict | None = None
         self.kernels: list[dict] = []
         self.publish: dict | None = None
@@ -70,6 +71,7 @@ class QueryPlan:
             "query_id": self.query_id,
             "merge": self.merge,
             "tree": self.tree,
+            "chips": self.chips,
             "cascade": self.cascade,
             "kernels": self.kernels,
             "publish": self.publish,
@@ -216,6 +218,24 @@ def format_plan(doc: dict) -> str:
             lines.append(
                 f"    p{pr['partition']} pruned by witness of "
                 f"p{pr['witness']}"
+            )
+    ch = doc.get("chips")
+    if ch is not None:
+        lines.append(
+            f"  chips n={ch.get('chips')} group_size={ch.get('group_size')}"
+            f" alive={ch.get('alive')} survivors={ch.get('survivors')}"
+            f" cross_levels={ch.get('levels')}"
+        )
+        for pr in ch.get("pruned") or []:
+            lines.append(
+                f"    chip {pr['chip']} pruned by witness of chip "
+                f"{pr['witness']}"
+            )
+        for pc in ch.get("per_chip") or []:
+            lines.append(
+                f"    chip {pc['chip']}: skyline={pc['skyline']}"
+                f" records={pc['records']} pending={pc['pending']}"
+                f"{' PRUNED' if pc.get('pruned') else ''}"
             )
     c = doc.get("cascade")
     if c is not None:

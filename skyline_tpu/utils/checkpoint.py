@@ -128,12 +128,15 @@ def save_engine(engine: SkylineEngine, path: str, extra_meta: dict | None = None
 
 
 def load_engine(
-    path: str, mesh=None, with_meta: bool = False, tracer=None, telemetry=None
+    path: str, mesh=None, mesh_chips: int = 0, with_meta: bool = False,
+    tracer=None, telemetry=None,
 ) -> SkylineEngine:
     """Restore an engine from a checkpoint written by ``save_engine``.
 
-    ``mesh`` re-applies a device-placement choice (it is runtime state, not
-    checkpoint state — an engine saved on one topology restores onto any).
+    ``mesh``/``mesh_chips`` re-apply a device-placement choice (runtime
+    state, not checkpoint state — an engine saved on one topology restores
+    onto any; a single-device checkpoint restores into a sharded engine and
+    vice versa because ``restore_all`` splits by chip-owned partition id).
     ``with_meta=True`` returns ``(engine, meta)`` so callers can read the
     ``extra`` doc (recovery offsets). ``tracer``/``telemetry`` thread the
     worker's observability hubs into the restored engine. A checkpoint
@@ -160,7 +163,12 @@ def load_engine(
             kw["tracer"] = tracer
         if telemetry is not None:
             kw["telemetry"] = telemetry
-        engine = SkylineEngine(cfg, mesh=mesh, **kw)
+        if mesh_chips:
+            from skyline_tpu.distributed import ShardedEngine
+
+            engine = ShardedEngine(cfg, chips=mesh_chips, **kw)
+        else:
+            engine = SkylineEngine(cfg, mesh=mesh, **kw)
         engine.records_in = meta["records_in"]
         engine.dropped = meta["dropped"]
         engine._results = meta["results"]

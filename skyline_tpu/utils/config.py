@@ -42,6 +42,10 @@ class JobConfig:
     ingest: str = "auto"  # auto|host|device (see EngineConfig.ingest)
     # worker runtime knobs
     mesh: int = 0  # >0: shard partitions over this many devices
+    # >0: sharded streaming engine — split the partition set into this
+    # many per-chip groups with a two-level tournament merge
+    # (skyline_tpu/distributed); mutually exclusive with mesh
+    mesh_chips: int = 0
     stats_port: int = 0  # >0: serve /stats + /healthz on this port
     # sliding-window mode (both 0 = unbounded/tumbling, the reference's
     # semantics); window must be a multiple of slide
@@ -111,6 +115,17 @@ class JobConfig:
             )
         if self.mesh < 0:
             raise ValueError(f"mesh must be >= 0, got {self.mesh}")
+        if self.mesh_chips < 0:
+            raise ValueError(
+                f"mesh_chips must be >= 0, got {self.mesh_chips}"
+            )
+        if self.mesh and self.mesh_chips:
+            # both shard the partition state across devices; the sharded
+            # engine (--mesh-chips) owns its own placement, so a mesh on
+            # top would double-shard
+            raise ValueError(
+                "--mesh and --mesh-chips are mutually exclusive"
+            )
         if self.max_drain_polls < 1:
             raise ValueError(
                 f"max_drain_polls must be >= 1, got {self.max_drain_polls}"
@@ -157,6 +172,11 @@ class JobConfig:
                 f"num_partitions {num_partitions} must be divisible "
                 f"by mesh size {self.mesh}"
             )
+        if self.mesh_chips and num_partitions % self.mesh_chips:
+            raise ValueError(
+                f"num_partitions {num_partitions} must be divisible "
+                f"by mesh_chips {self.mesh_chips}"
+            )
         if (self.window_size > 0) != (self.slide > 0):
             raise ValueError(
                 "--window and --slide must be given together (both > 0)"
@@ -165,6 +185,12 @@ class JobConfig:
             raise ValueError(
                 f"window_size {self.window_size} must be a multiple of "
                 f"slide {self.slide}"
+            )
+        if self.window_size and self.mesh_chips:
+            # the sliding engine has no partition groups to shard
+            raise ValueError(
+                "sliding-window mode (--window/--slide) does not support "
+                "--mesh-chips"
             )
         if self.window_size and (
             self.grid_prefilter
@@ -328,6 +354,13 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=env_int("SKYLINE_MESH", defaults.mesh),
                     help="shard the partition state over this many devices "
                          "(0 = single device)")
+    ap.add_argument("--mesh-chips", type=int,
+                    default=env_int("SKYLINE_MESH_CHIPS",
+                                    defaults.mesh_chips),
+                    help="sharded streaming engine: split partitions into "
+                         "this many per-chip groups with a two-level "
+                         "tournament merge (0 = single device; mutually "
+                         "exclusive with --mesh)")
     ap.add_argument("--stats-port", type=int,
                     default=env_int("SKYLINE_STATS_PORT", defaults.stats_port),
                     help="serve live /stats JSON on this port (0 = off)")
@@ -445,6 +478,7 @@ def parse_job_args(argv=None) -> JobConfig:
         overlap_rows=a.overlap_rows,
         ingest=a.ingest,
         mesh=a.mesh,
+        mesh_chips=a.mesh_chips,
         stats_port=a.stats_port,
         window_size=a.window_size,
         slide=a.slide,

@@ -1,0 +1,28 @@
+"""Version shims over renamed/moved JAX APIs.
+
+The meshed paths target the modern ``jax.shard_map`` entry point
+(``check_vma=`` keyword). Older JAX (< 0.5) only ships
+``jax.experimental.shard_map.shard_map`` with the same semantics under
+the ``check_rep=`` keyword — one alias here keeps every call site on the
+modern spelling instead of three copies of the fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when present, else the experimental equivalent
+    (``check_vma`` maps onto the old ``check_rep`` replication check)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
