@@ -248,6 +248,7 @@ def sharded_leg(cfg, ids, x, required) -> dict:
     import dataclasses
 
     from skyline_tpu.distributed import ShardedEngine, ShardedPartitionSet
+    from skyline_tpu.telemetry import Telemetry
 
     scfg = cfg
     if getattr(cfg, "ingest", "host") == "device":
@@ -255,7 +256,11 @@ def sharded_leg(cfg, ids, x, required) -> dict:
         # ingest routing), so this leg always measures the host path
         scfg = dataclasses.replace(cfg, ingest="host")
     chips = 2 if scfg.parallelism % 2 == 0 else 1
-    eng = ShardedEngine(scfg, chips=chips)
+    # a hub activates the fleet plane (ISSUE 13): the per-chip loads,
+    # imbalance index and interconnect-row accounting of THIS window ride
+    # the artifact as the top-level "fleet" block (child_main lifts it)
+    hub = Telemetry()
+    eng = ShardedEngine(scfg, chips=chips, telemetry=hub)
     n = x.shape[0]
     chunk = 65536
     for i in range(0, n, chunk):
@@ -286,7 +291,43 @@ def sharded_leg(cfg, ids, x, required) -> dict:
         "chips_considered": pst["chips_considered"],
     }
     block["pruned_chip_fraction"] = pst["pruned_chip_fraction"]
+    if hub.fleet is not None:
+        # bench_compare gates on fleet.imbalance_index (creeping chip skew
+        # means the partitioner is funneling rows to few chips)
+        block["fleet"] = hub.fleet.doc()
     return block
+
+
+def workload_stamp(x) -> dict:
+    """Workload-plane stamp (ISSUE 13): run the streaming characterizer
+    over the bench window in ingest-sized chunks and record the regime it
+    reports plus its own wall cost. The stamp records the stream's
+    MEASURED regime, not the generator's label — at d >= 4 the unified
+    anti-correlated generator's wide epsilon band genuinely produces
+    positively correlated raw values (telemetry/workload.py docstring),
+    and the raw signals (sum_ratio / rho / dispersion) ride along so the
+    artifact stays auditable either way."""
+    from skyline_tpu.telemetry.workload import WorkloadCharacterizer
+
+    t0 = time.perf_counter()
+    w = WorkloadCharacterizer(int(x.shape[1]))
+    chunk = 4096
+    for i in range(0, x.shape[0], chunk):
+        w.observe(x[i : i + chunk])
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    st = w.stats()
+    last = st["epochs"][-1] if st["epochs"] else {}
+    return {
+        "kind": st["kind"],
+        "rho": st["rho"],
+        "sum_ratio": last.get("sum_ratio"),
+        "dispersion": last.get("dispersion"),
+        "epochs_closed": st["epochs_closed"],
+        "drift_total": st["drift_total"],
+        "rows_seen": st["rows_seen"],
+        "rows_sampled": st["rows_sampled"],
+        "characterize_wall_ms": round(wall_ms, 1),
+    }
 
 
 def serve_leg(d: int, algo: str) -> dict:
@@ -579,6 +620,17 @@ def child_main(backend: str) -> None:
         )
     except Exception as e:  # pragma: no cover - diagnostic path
         sharded = {"error": f"{type(e).__name__}: {e}"}
+    # the fleet block rides top-level so bench_compare's dotted path
+    # (fleet, imbalance_index) resolves without reaching through sharded
+    fleet = (
+        sharded.pop("fleet", {"skipped": True})
+        if isinstance(sharded, dict)
+        else {"skipped": True}
+    )
+    try:
+        workload = workload_stamp(anti_correlated(rng, n, d, 0, 10000))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        workload = {"error": f"{type(e).__name__}: {e}"}
     try:
         analysis = analysis_stamp()
     except Exception as e:  # pragma: no cover - diagnostic path
@@ -617,6 +669,8 @@ def child_main(backend: str) -> None:
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,
                 "sharded": sharded,
+                "fleet": fleet,
+                "workload": workload,
                 "freshness": freshness,
                 "kernel_profile": kernel_profile,
                 "explain": explain,

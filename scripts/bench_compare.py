@@ -100,6 +100,11 @@ METRICS = (
     # artifacts (pre-lineage) -> skipped
     ("freshness.read_lag_p99_ms", ("freshness", "read_lag_p99_ms"),
      False, False),
+    # fleet plane (ISSUE 13, bench.py sharded_leg hub): the chip-load
+    # imbalance index of the sharded window — creeping UP means the
+    # partitioner started funneling rows to few chips (lower = balanced,
+    # 1.0 = perfect). Absent on pre-fleet artifacts -> skipped
+    ("fleet.imbalance_index", ("fleet", "imbalance_index"), False, False),
 )
 
 

@@ -9,7 +9,12 @@
 #   * /explain's latest plan carries per-chip attribution
 #     (merge.path=sharded_tree, pruned/survivor lists consistent with
 #     /stats),
-#   * the flat worker stamps NO sharded block (the plane is gated).
+#   * the flat worker stamps NO sharded block (the plane is gated),
+#   * /fleet on the sharded worker is live (RUNBOOK 2o): per-chip ingest
+#     series non-zero, imbalance gauge present, chip 0 ships 0
+#     interconnect rows; the flat worker answers {"enabled": false},
+#   * /metrics carries the labeled skyline_chip_* families and the
+#     skyline_workload_drift_total counter.
 #
 #   scripts/mesh_smoke.sh
 #
@@ -63,15 +68,22 @@ def run(mesh_chips):
             stats = json.load(r)
         with urllib.request.urlopen(f"{base}/explain", timeout=5) as r:
             plan = json.load(r)
+        with urllib.request.urlopen(f"{base}/fleet", timeout=5) as r:
+            fleet = json.load(r)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
     finally:
         w.close()
-    return int(g), digest, stats, plan
+    return int(g), digest, stats, plan, fleet, metrics
 
 
-g_flat, d_flat, s_flat, _ = run(0)
+g_flat, d_flat, s_flat, _, fleet_flat, _ = run(0)
 assert "sharded" not in s_flat, "flat worker stamped a sharded block"
+# flat worker: the /fleet route answers rather than 404s, but reports the
+# plane off (RUNBOOK 2o) — scrapers can probe unconditionally
+assert fleet_flat["enabled"] is False, fleet_flat
 
-g_sh, d_sh, s_sh, plan = run(4)
+g_sh, d_sh, s_sh, plan, fleet, metrics = run(4)
 sh = s_sh["sharded"]
 assert sh["chips"] == 4 and sh["group_size"] >= 1, sh
 assert sh["merges"] >= 1, sh
@@ -92,11 +104,28 @@ pruned_ids = {p["chip"] for p in ch["pruned"]}
 assert pruned_ids and pruned_ids.isdisjoint(ch["survivors"]), ch
 assert len(ch["per_chip"]) == 4, ch
 
+# fleet plane (RUNBOOK 2o): the /fleet join on a live sharded worker
+assert fleet["enabled"] is True and fleet["chips"] == 4, fleet
+per = {pc["chip"]: pc for pc in fleet["per_chip"]}
+assert len(per) == 4 and all(pc["ingest_rows"] > 0 for pc in per.values()), \
+    f"per-chip ingest series dead: {fleet}"
+assert fleet["imbalance_index"] >= 1.0, fleet
+assert per[0]["interconnect_rows"] == 0, \
+    f"root chip shipped rows to itself: {per[0]}"
+assert 'skyline_chip_ingest_rows_total{chip="0"}' in metrics, \
+    "labeled per-chip family missing from /metrics"
+assert "skyline_fleet_imbalance_index" in metrics, metrics[-400:]
+assert "skyline_workload_drift_total" in metrics, \
+    "workload drift counter missing from /metrics"
+
 print(f"[mesh-smoke] identity ok: g={g_sh}, sha256 {d_sh[:16]}… identical "
       "flat vs 4 chips")
 print(f"[mesh-smoke] chip prune ok: {sh['chips_pruned']} chip(s) pruned, "
       f"fraction={sh['pruned_chip_fraction']}")
 print(f"[mesh-smoke] explain ok: path={plan['merge']['path']}, "
       f"pruned={sorted(pruned_ids)}, survivors={ch['survivors']}")
+print(f"[mesh-smoke] fleet ok: imbalance={fleet['imbalance_index']}, "
+      f"interconnect_rows_total={fleet['interconnect_rows_total']}, "
+      "labeled chip families on /metrics")
 print("[mesh-smoke] PASS")
 EOF

@@ -9,7 +9,9 @@
 #   * /stats carries latency_ms histogram summaries (p50/p99 tiles),
 #   * the --trace-out file written on close() validates the same way,
 # and finally exercise the bench regression gate both directions
-# (ok -> rc 0, forced regression -> rc 1).
+# (ok -> rc 0, forced regression -> rc 1) plus the perf-trajectory
+# sentinel (healthy history -> rc 0, injected rolling-baseline drift
+# -> rc 1).
 #
 #   scripts/obs_smoke.sh
 #
@@ -428,6 +430,33 @@ if python scripts/bench_compare.py --dir "$REGRESSED"; then
   exit 1
 fi
 echo "[obs-smoke] bench_compare gate ok (pass + forced-regression trip)"
+
+# perf-trajectory sentinel (RUNBOOK 2o): the checked-in trajectory must be
+# healthy, and a slow drift — every pairwise step inside the bench_compare
+# threshold, but the newest round 40% below the rolling median — must trip
+# with rc 1 (exactly the regression shape the pairwise gate cannot see)
+python -m skyline_tpu.telemetry.sentinel --dir .
+DRIFTED="$(mktemp -d)"
+JAX_PLATFORMS=cpu python - "$DRIFTED" <<'EOF'
+import glob, json, os, sys
+dst = sys.argv[1]
+found = sorted(glob.glob("BENCH_r*.json"))
+assert found, "need a BENCH_r*.json artifact"
+with open(found[-1]) as f:
+    base = json.load(f)
+# self-relative trajectory: four steady rounds, then a drifted fifth whose
+# per-step deltas (~12% each) all pass pairwise but compound to -40%
+for r, scale in enumerate((1.00, 0.99, 1.01, 1.00, 0.60), start=1):
+    doc = json.loads(json.dumps(base))
+    doc["parsed"]["value"] *= scale
+    with open(os.path.join(dst, f"BENCH_r{r:02d}.json"), "w") as f:
+        json.dump(doc, f)
+EOF
+if python -m skyline_tpu.telemetry.sentinel --dir "$DRIFTED"; then
+  echo "[obs-smoke] FAIL: sentinel missed a 40% rolling-baseline drift" >&2
+  exit 1
+fi
+echo "[obs-smoke] sentinel ok (healthy trajectory + drift trip)"
 
 # sharded-engine gate: the two-level chip tournament lands byte-identical
 # to the flat worker and the chip-witness prefilter is live (RUNBOOK 2n)

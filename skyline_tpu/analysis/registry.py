@@ -360,6 +360,55 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SLO_AUDIT_DIVERGENCE", "float", 0.0001,
        "SLO target: max fraction of audited snapshots diverging from the "
        "host oracle", "telemetry/slo", runbook="§2l"),
+    _k("SKYLINE_FLEET", "bool", True,
+       "per-chip fleet plane on the sharded engine: skyline_chip_* "
+       "labeled metric families, imbalance index + skew ring, per-chip "
+       "tournament spans, and GET /fleet", "telemetry", runbook="§2o"),
+    _k("SKYLINE_FLEET_IMBALANCE_THRESHOLD", "float", 2.0,
+       "imbalance index (max/mean chip ingest load) above which a "
+       "fleet.imbalance flight-recorder entry fires (edge-triggered per "
+       "excursion)", "telemetry", runbook="§2o"),
+    _k("SKYLINE_FLEET_RING", "int", 64,
+       "rolling skew ring capacity (per-merge imbalance samples behind "
+       "the skew score)", "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD", "bool", True,
+       "streaming workload characterizer: per-dim quantile sketches, "
+       "correlation estimate, uniform/correlated/anti_correlated "
+       "classification, drift detection; the regime tag on every EXPLAIN "
+       "plan", "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_EPOCH_ROWS", "int", 4096,
+       "sampled rows per characterizer epoch (classification + drift "
+       "check cadence)", "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_SAMPLE_CAP", "int", 512,
+       "max rows sampled per ingest batch (deterministic stride, no RNG)",
+       "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_RING", "int", 64,
+       "epoch-summary and query-trajectory ring capacity", "telemetry",
+       runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_SUM_RATIO", "float", 0.5,
+       "row-sum variance ratio below which the stream classifies "
+       "anti_correlated (constant-sum band; 1.0 = independent dims)",
+       "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_CORR_THRESHOLD", "float", 0.25,
+       "mean pairwise correlation above which the stream classifies "
+       "correlated (subject to the dispersion tiebreak)", "telemetry",
+       runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_DISP_THRESHOLD", "float", 0.27,
+       "within-row coefficient-of-variation above which a positively "
+       "correlated stream reclassifies as wide-band anti_correlated "
+       "(shared per-row scale)", "telemetry", runbook="§2o"),
+    _k("SKYLINE_WORKLOAD_DRIFT_THRESHOLD", "float", 0.2,
+       "per-dim p50 shift (normalized by the frozen sketch range) beyond "
+       "which consecutive epochs count as drift", "telemetry",
+       runbook="§2o"),
+    _k("SKYLINE_SENTINEL_WINDOW", "int", 4,
+       "perf-trajectory sentinel: rolling-baseline window (newest "
+       "artifact compared against the median of up to N prior comparable "
+       "rounds)", "telemetry", runbook="§2o"),
+    _k("SKYLINE_SENTINEL_THRESHOLD", "float", 0.3,
+       "perf-trajectory sentinel: default max fractional regression vs "
+       "the rolling baseline (per-metric rules can override)",
+       "telemetry", runbook="§2o"),
     # -- bench harness (bench.py) ------------------------------------------
     _k("BENCH_N", "int", None,
        "window rows (default 1M on TPU, BENCH_CPU_N on the fallback)",

@@ -47,7 +47,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
-from skyline_tpu.ops.dispatch import chip_prune_enabled, merge_cache_enabled
+from skyline_tpu.ops.dispatch import (
+    chip_prune_enabled,
+    fleet_enabled,
+    merge_cache_enabled,
+)
 from skyline_tpu.parallel.chips import chip_devices
 from skyline_tpu.resilience.faults import fault_point
 from skyline_tpu.stream.batched import PartitionSet, PartitionView
@@ -176,6 +180,8 @@ class ShardedPartitionSet:
         self._profiler = None
         self._flight = None
         self._explain = None
+        self._fleet = None
+        self._spans = None
         # facade-level epoch-keyed merge cache over the TWO-LEVEL result
         # (chips additionally keep their own intra-chip caches)
         self._gm_cache: dict | None = None
@@ -261,9 +267,16 @@ class ShardedPartitionSet:
 
     # -- observability hooks -------------------------------------------------
 
-    def attach_observability(self, profiler=None, flight=None) -> None:
+    def attach_observability(
+        self, profiler=None, flight=None, fleet=None, spans=None
+    ) -> None:
         self._profiler = profiler
         self._flight = flight
+        # fleet plane (ISSUE 13): per-chip load/prune/interconnect
+        # accounting + the per-chip tournament child spans — host-side
+        # bookkeeping only, never inside the merge kernels
+        self._fleet = fleet
+        self._spans = spans
         for c in self._chips:
             c.attach_observability(profiler=profiler, flight=flight)
 
@@ -294,6 +307,8 @@ class ShardedPartitionSet:
         self.records_seen[p] += n
         self._pending_rows[p] += n
         c, lp = self._loc(p)
+        if self._fleet is not None:
+            self._fleet.note_ingest(c, n)
         self._chips[c].add_batch(lp, values, max_id, now_ms)
 
     def maybe_flush(self) -> bool:
@@ -317,8 +332,13 @@ class ShardedPartitionSet:
     def flush_all(self, tighten: bool = True) -> None:
         for c, chip in enumerate(self._chips):
             rows = chip.pending_rows_total
+            t0 = time.perf_counter_ns()
             with self._dev(c):
                 chip.flush_all(tighten)
+            if self._fleet is not None and rows:
+                self._fleet.note_flush(
+                    c, rows, (time.perf_counter_ns() - t0) / 1e6
+                )
             if self._chip_wal is not None and rows:
                 self._chip_wal.note_flush(c, rows, epoch_hex(chip.epoch_key))
         self._pending_rows[:] = 0
@@ -387,7 +407,9 @@ class ShardedPartitionSet:
         chip_pts: list = []  # (w_c, d) device buffer on chip c, or None
         chip_summary: list[np.ndarray | None] = []
         want_prune = chip_prune_enabled() and C > 1
+        trace_id = h.explain.trace_id if h.explain is not None else None
         for c, chip in enumerate(self._chips):
+            t0 = time.perf_counter_ns()
             with self._dev(c):
                 fault_point("sharded.chip_merge")
                 ch = chip.global_merge_launch(False)
@@ -420,6 +442,16 @@ class ShardedPartitionSet:
                 else:
                     chip_pts.append(None)
                     chip_summary.append(None)
+            t1 = time.perf_counter_ns()
+            if self._spans is not None:
+                # level-1 child span: /trace shows which chip's local
+                # tournament the merge wall went to
+                self._spans.record(
+                    "chip_merge", t0, t1, trace_id=trace_id, tid=c + 1,
+                    args={"chip": c, "level": 1, "skyline": int(g_c)},
+                )
+            if self._fleet is not None:
+                self._fleet.note_level1(c, g_c, (t1 - t0) / 1e6)
         concat_counts = np.concatenate(chip_counts)
         alive = np.array([g > 0 for g in chip_g], dtype=bool)
         considered = int(alive.sum())
@@ -462,11 +494,16 @@ class ShardedPartitionSet:
             )
             return h
         # -- gather survivors onto the root device, ascending chip order ---
+        t2 = time.perf_counter_ns()
         root_dev = self._devices[0]
         leaves = []
         for c in survivors:
             g = chip_g[c]
             w = chip_pts[c].shape[0]
+            if self._fleet is not None:
+                # the interconnect crossing: the padded root buffer ships
+                # to chip 0 — except chip 0's own root, already resident
+                self._fleet.note_level2(c, False, 0 if c == 0 else w)
             vals = jax.device_put(chip_pts[c], root_dev)
             # the chip root carries no pids; rebuild them host-side from
             # the per-partition survivor counts (root rows are ascending
@@ -509,6 +546,15 @@ class ShardedPartitionSet:
             h.stats.copy_to_host_async()
         except AttributeError:
             pass
+        if self._spans is not None:
+            # level-2 child span: survivor gather + cross-chip pairwise
+            # launches (kernels may still be in flight at harvest)
+            self._spans.record(
+                "cross_chip_merge", t2, time.perf_counter_ns(),
+                trace_id=trace_id, tid=0,
+                args={"level": 2, "survivors": len(survivors),
+                      "pruned": npruned, "levels": levels},
+            )
         self._note_merge_info(
             h, chip_g, considered, pruned, witness_of, survivors, levels, cand
         )
@@ -547,6 +593,14 @@ class ShardedPartitionSet:
             "candidates_per_level": cand,
             "per_chip": per_chip,
         }
+        if self._fleet is not None:
+            for c in np.flatnonzero(pruned):
+                self._fleet.note_level2(int(c), True, 0)
+            imb = self._fleet.note_merge_done()
+            info["imbalance"] = {
+                "imbalance_index": imb["imbalance_index"],
+                "skew_score": imb["skew_score"],
+            }
         self.last_chip_info = info
         chip_infos = [c.last_tree_info for c in self._chips]
         intra_pruned = sum(
@@ -702,6 +756,8 @@ class ShardedPartitionSet:
             "devices": [str(d) for d in self._devices],
             "last": self.last_chip_info,
         }
+        if self._fleet is not None:
+            out["fleet"] = self._fleet.doc()
         if self._chip_wal is not None:
             out["chip_wal"] = self._chip_wal.stats()
         return out
@@ -739,9 +795,19 @@ class ShardedEngine(SkylineEngine):
         self.partitions = [
             PartitionView(self.pset, i) for i in range(config.num_partitions)
         ]
+        fleet = None
+        if telemetry is not None and fleet_enabled():
+            from skyline_tpu.telemetry.fleet import FleetStats
+
+            fleet = FleetStats(self.mesh_chips, flight=telemetry.flight)
+            # hang it on the hub: both HTTP surfaces serve /fleet and the
+            # skyline_chip_*{chip=...} families straight from there
+            telemetry.fleet = fleet
         self.pset.attach_observability(
             profiler=self.profiler,
             flight=telemetry.flight if telemetry is not None else None,
+            fleet=fleet,
+            spans=telemetry.spans if telemetry is not None else None,
         )
 
     def stats(self, include_skyline_counts: bool = False) -> dict:

@@ -28,6 +28,10 @@ Endpoints:
   GET /audit    audit-plane verdict: shadow-verification totals, canary
                 path coverage, divergence bundles
                 (?trace_id=... for one check record)
+  GET /fleet    per-chip fleet join: ingest/flush/merge loads per chip,
+                imbalance index + skew score, freshness watermark, last
+                EXPLAIN chip attribution (sharded workers; a flat worker
+                reports {"enabled": false})
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -204,6 +208,14 @@ class StatsServer:
                     else:
                         code, doc = outer._audit_doc(qs)
                         handler._reply(code, doc)
+                elif path == "/fleet":
+                    if outer.telemetry is None:
+                        handler._reply(404, {"error": "no telemetry hub"})
+                    else:
+                        try:
+                            handler._reply(200, outer._fleet_doc())
+                        except Exception as e:
+                            handler._reply(500, {"error": str(e)})
                 elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
@@ -266,6 +278,13 @@ class StatsServer:
                 return 404, {"error": "no matching check", "ring": rec.doc()}
             return 200, check
         return 200, rec.doc()
+
+    def _fleet_doc(self) -> dict:
+        """The /fleet join: per-chip stats + freshness watermark + last
+        EXPLAIN chip attribution (telemetry/fleet.py)."""
+        from skyline_tpu.telemetry import fleet_doc
+
+        return fleet_doc(self.telemetry, self._callback())
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

@@ -247,6 +247,38 @@ def audit_enabled() -> bool:
     return env_bool("SKYLINE_AUDIT", True)
 
 
+def fleet_enabled() -> bool:
+    """``SKYLINE_FLEET`` gates the per-chip fleet plane
+    (``telemetry/fleet.py``) on the sharded engine: ingest/flush/merge
+    accounting per partition group, level-2 prune outcomes, interconnect
+    row counts, the imbalance index + skew ring, the per-chip child spans
+    under the tournament merge, and ``GET /fleet``. Cost is a few list
+    adds per flush/merge on the HOST side of an already host-orchestrated
+    tournament (nothing inside jit; the identity law is unaffected —
+    ``benchmarks/fleet.py`` asserts byte-identity), so default ON; set
+    ``0`` for the unobserved baseline. No-op on flat (non-sharded)
+    engines. Read lazily at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_FLEET", True)
+
+
+def workload_enabled() -> bool:
+    """``SKYLINE_WORKLOAD`` gates the streaming workload characterizer
+    (``telemetry/workload.py``): a bounded per-batch sample feeds
+    per-dimension quantile sketches, a correlation estimate, and drift
+    detection, classifying the stream uniform/correlated/anti_correlated
+    — the regime tag EXPLAIN stamps on every answered query and the
+    substrate the ROADMAP's auto-tuner will read. Cost is one numpy pass
+    over at most ``SKYLINE_WORKLOAD_SAMPLE_CAP`` rows per ingest batch
+    (host-side, nothing inside jit, skyline bytes untouched), so default
+    ON; set ``0`` for the uncharacterized baseline
+    (``benchmarks/fleet.py`` A/B). Read lazily at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_WORKLOAD", True)
+
+
 def profile_cost_enabled() -> bool:
     """``SKYLINE_PROFILE_COST`` additionally captures XLA
     ``cost_analysis()`` FLOPs/bytes per dispatch signature via a one-shot

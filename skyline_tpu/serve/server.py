@@ -35,6 +35,10 @@ operator observability; this one serves the skyline itself. Endpoints:
   GET  /audit     audit-plane verdict: shadow-verification totals, canary
                   path coverage, divergence bundles (``?trace_id=`` joins
                   one check back to /explain and /trace).
+  GET  /fleet     per-chip fleet join: ingest/flush/merge loads per chip,
+                  imbalance index + skew score, freshness watermark, last
+                  EXPLAIN chip attribution (sharded workers; flat workers
+                  report {"enabled": false}).
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -336,6 +340,8 @@ class SkylineServer:
             await self._explain(writer, params)
         elif path == "/audit" and method == "GET":
             await self._audit(writer, params)
+        elif path == "/fleet" and method == "GET":
+            await self._fleet(writer)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -550,6 +556,19 @@ class SkylineServer:
             await self._reply(writer, 200, check)
             return
         await self._reply(writer, 200, rec.doc())
+
+    async def _fleet(self, writer):
+        """The per-chip fleet join (telemetry/fleet.py): chip loads +
+        imbalance index + freshness watermark + last EXPLAIN chip
+        attribution. Observability must not 500 the plane down, so the
+        stats callback failure degrades to a watermark-less doc."""
+        from skyline_tpu.telemetry import fleet_doc
+
+        try:
+            stats = dict(self.stats_cb()) if self.stats_cb is not None else {}
+        except Exception:
+            stats = {}
+        await self._reply(writer, 200, fleet_doc(self.telemetry, stats))
 
     async def _deltas(self, writer, params):
         ok, retry = self.admission.admit_read()

@@ -236,8 +236,13 @@ class SkylineEngine:
             explain_enabled,
             freshness_enabled,
             kernel_profile_enabled,
+            workload_enabled,
         )
-        from skyline_tpu.telemetry import FreshnessTracker, KernelProfiler
+        from skyline_tpu.telemetry import (
+            FreshnessTracker,
+            KernelProfiler,
+            WorkloadCharacterizer,
+        )
 
         self.freshness = (
             FreshnessTracker(telemetry) if freshness_enabled() else None
@@ -275,6 +280,21 @@ class SkylineEngine:
             self.auditor = Auditor(self, telemetry)
             telemetry.inc("audit.checks", 0)
             telemetry.inc("audit.divergence", 0)
+        # workload plane (ISSUE 13): streaming regime characterization fed
+        # from the ingest path — per-dim quantile sketches, a correlation
+        # estimate, and drift detection between consecutive epochs. All
+        # host-side on a bounded deterministic sample; published skyline
+        # bytes are untouched on/off (benchmarks/fleet.py pins this). Hung
+        # off the hub so both HTTP surfaces serve the ``workload`` block.
+        self.workload = None
+        if workload_enabled():
+            self.workload = WorkloadCharacterizer(
+                config.dims,
+                counters=telemetry.counters if telemetry is not None else None,
+                flight=telemetry.flight if telemetry is not None else None,
+            )
+            if telemetry is not None:
+                telemetry.workload = self.workload
 
     def attach_snapshots(self, store) -> None:
         """Publish completed global skylines to ``store`` (a
@@ -326,6 +346,11 @@ class SkylineEngine:
             now_ms = time.time() * 1000.0
         cfg = self.config
         self.records_in += values.shape[0]
+        if self.workload is not None:
+            # characterize BEFORE the ingest path forks (device routing vs
+            # host routing vs grid prefilter) so every regime sees the same
+            # raw stream; bounded stride-sample inside, never the full batch
+            self.workload.observe(values)
         ev_hi = None
         if self.freshness is not None:
             # stamp the batch's event-time window; absent stamps fall back
@@ -687,6 +712,10 @@ class SkylineEngine:
                     trace_id=q.trace_id,
                     args={"query_id": q.qid, "skyline_size": skyline_size},
                 )
+        if self.workload is not None and partial_missing is None:
+            # one trajectory point per complete answer (partials would
+            # poison the dominance-rate series with truncated skylines)
+            self.workload.note_query(skyline_size, self.records_in)
         if q.plan is not None:
             self._finalize_plan(
                 q,
@@ -750,6 +779,10 @@ class SkylineEngine:
                 "total_ms": round(float(total_ms), 3),
                 "latency_ms": round(float(latency_ms), 3),
             }
+            if self.workload is not None:
+                # the regime this answer was computed under — joins the
+                # drift trajectory to individual answers in /explain
+                plan.workload = self.workload.regime()
             self.telemetry.explain.add(plan.to_doc())
             self.telemetry.inc("explain.records")
             if q.span_t0_ns:
@@ -987,6 +1020,8 @@ class SkylineEngine:
             out["audit"] = self.telemetry.audit.doc()
         if self.freshness is not None:
             out["freshness"] = self.freshness.stats()
+        if self.workload is not None:
+            out["workload"] = self.workload.stats()
         if self.profiler is not None:
             phase = self.tracer.report().get("flush/merge_kernel")
             out["kernel_profile"] = self.profiler.doc(

@@ -30,12 +30,14 @@ from skyline_tpu.telemetry.prometheus import (
 )
 from skyline_tpu.telemetry.audit import AuditRecorder
 from skyline_tpu.telemetry.explain import ExplainRecorder, QueryPlan
+from skyline_tpu.telemetry.fleet import FleetStats, fleet_doc
 from skyline_tpu.telemetry.freshness import FreshnessTracker
 from skyline_tpu.telemetry.profiler import FlightRecorder, KernelProfiler
 from skyline_tpu.telemetry.prometheus import flatten_gauges
 from skyline_tpu.telemetry.prometheus import render as render_prometheus
 from skyline_tpu.telemetry.slo import SloEngine
 from skyline_tpu.telemetry.spans import SpanRecorder, mint_trace_id
+from skyline_tpu.telemetry.workload import WorkloadCharacterizer
 
 
 class Telemetry:
@@ -66,6 +68,12 @@ class Telemetry:
         # audit plane (ISSUE 10): the shadow-verification verdict ring
         # behind GET /audit on both HTTP surfaces
         self.audit = AuditRecorder(env_int("SKYLINE_AUDIT_RING", 256))
+        # fleet/workload planes (ISSUE 13): attached by the sharded facade
+        # and the engine respectively (None on flat/ungated workers); both
+        # HTTP surfaces read them through the hub — /fleet, the workload
+        # block, and the skyline_chip_*{chip=...} metric families
+        self.fleet = None
+        self.workload = None
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -131,11 +139,16 @@ class Telemetry:
         counters["compile_cache.misses"] = cc["misses"]
         if extra_counters:
             counters.update(extra_counters)
+        labeled_counters = labeled_gauges = None
+        if self.fleet is not None:
+            labeled_counters, labeled_gauges = self.fleet.labeled_series()
         return render_prometheus(
             counters=counters,
             gauges=gauges,
             histograms=self.histograms(),
             prefix=prefix,
+            labeled_counters=labeled_counters,
+            labeled_gauges=labeled_gauges,
         )
 
 
@@ -144,6 +157,7 @@ __all__ = [
     "Counters",
     "DEFAULT_EDGES",
     "ExplainRecorder",
+    "FleetStats",
     "FlightRecorder",
     "FreshnessTracker",
     "Histogram",
@@ -155,7 +169,9 @@ __all__ = [
     "SpanRecorder",
     "Telemetry",
     "Tracer",
+    "WorkloadCharacterizer",
     "flatten_gauges",
+    "fleet_doc",
     "mint_trace_id",
     "render_prometheus",
 ]

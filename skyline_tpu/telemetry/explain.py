@@ -49,7 +49,7 @@ class QueryPlan:
 
     __slots__ = (
         "trace_id", "query_id", "merge", "tree", "chips", "cascade",
-        "kernels", "publish", "timing",
+        "kernels", "publish", "timing", "workload",
     )
 
     def __init__(self, trace_id: str | None, query_id: str):
@@ -62,6 +62,7 @@ class QueryPlan:
         self.kernels: list[dict] = []
         self.publish: dict | None = None
         self.timing: dict | None = None
+        self.workload: dict | None = None  # regime tag (telemetry/workload.py)
 
     def to_doc(self) -> dict:
         """Freeze into the JSON-serializable record the ring stores."""
@@ -76,6 +77,7 @@ class QueryPlan:
             "kernels": self.kernels,
             "publish": self.publish,
             "timing": self.timing,
+            "workload": self.workload,
         }
 
 
@@ -250,6 +252,12 @@ def format_plan(doc: dict) -> str:
             f" n={k.get('n_bucket')} {k.get('backend')}"
             f"{' mp' if k.get('mp') else ''}: {k.get('calls')} call(s)"
             f" {k.get('wall_ms')} ms"
+        )
+    w = doc.get("workload")
+    if w is not None:
+        lines.append(
+            f"  workload kind={w.get('kind')} rho={w.get('rho')}"
+            f" epoch={w.get('epoch')} drift_total={w.get('drift_total')}"
         )
     p = doc.get("publish")
     if p is not None:

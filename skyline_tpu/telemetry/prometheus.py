@@ -58,23 +58,47 @@ def flatten_gauges(doc: dict, prefix: str = "") -> dict[str, float]:
     return out
 
 
+def _labeled_family(lines: list[str], m: str, kind: str, series) -> None:
+    """One labeled family: a single # TYPE line, then every label set's
+    sample. ``series`` is ``[(((key, value), ...), sample), ...]``."""
+    lines.append(f"# TYPE {m} {kind}")
+    for labels, value in series:
+        text = _labels_text(labels)
+        brace = f"{{{text}}}" if text else ""
+        lines.append(f"{m}{brace} {_fmt(value)}")
+
+
 def render(
     counters: dict[str, float] | None = None,
     gauges: dict[str, float] | None = None,
     histograms=None,
     prefix: str = "skyline",
+    labeled_counters=None,
+    labeled_gauges=None,
 ) -> str:
     """Render one exposition document. ``histograms`` is an iterable of
-    ``telemetry.histogram.Histogram``."""
+    ``telemetry.histogram.Histogram``; ``labeled_counters`` /
+    ``labeled_gauges`` map family name -> ``[(label tuple, value), ...]``
+    (the fleet plane's per-chip ``skyline_chip_*{chip=...}`` series).
+    Unlabeled output is byte-identical when both are absent/empty."""
     lines: list[str] = []
     for name in sorted(counters or {}):
         m = f"{prefix}_{sanitize(name)}_total"
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m} {_fmt(counters[name])}")
+    for name in sorted(labeled_counters or {}):
+        _labeled_family(
+            lines, f"{prefix}_{sanitize(name)}_total", "counter",
+            labeled_counters[name],
+        )
     for name in sorted(gauges or {}):
         m = f"{prefix}_{sanitize(name)}"
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m} {_fmt(gauges[name])}")
+    for name in sorted(labeled_gauges or {}):
+        _labeled_family(
+            lines, f"{prefix}_{sanitize(name)}", "gauge", labeled_gauges[name],
+        )
     # group histograms into families: one # TYPE line per metric name, then
     # every label set's series. Unlabeled histograms are one-member families,
     # so their rendering is unchanged.

@@ -1,0 +1,205 @@
+"""Fleet observability plane (ISSUE 13): per-chip health & skew telemetry.
+
+Unit math for the imbalance index + edge-triggered flight entries, the
+labeled ``skyline_chip_*{chip=...}`` Prometheus families, the sharded
+engine feeding the plane end-to-end, the ``/fleet`` join on the stats
+HTTP surface, and the byte-identity law with the plane on or off.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from conftest import gen_points
+from skyline_tpu.distributed import ShardedEngine
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry.fleet import FleetStats, fleet_doc
+from skyline_tpu.telemetry.profiler import FlightRecorder
+
+
+# --------------------------------------------------------------------------
+# FleetStats unit math
+# --------------------------------------------------------------------------
+
+
+def test_imbalance_index_math():
+    f = FleetStats(2, imbalance_threshold=2.0)
+    f.note_ingest(0, 300)
+    f.note_ingest(1, 100)
+    doc = f.note_merge_done()
+    # max load / mean load: 300 / 200
+    assert doc["imbalance_index"] == pytest.approx(1.5)
+    assert doc["loads"] == [300, 100]
+    assert f.doc()["merges"] == 1
+
+
+def test_imbalance_flight_entry_is_edge_triggered():
+    flight = FlightRecorder(16)
+    f = FleetStats(2, flight=flight, imbalance_threshold=1.2)
+    f.note_ingest(0, 900)
+    f.note_ingest(1, 100)
+    # index 1.8 > 1.2 on every merge, but the excursion logs ONCE
+    for _ in range(3):
+        f.note_merge_done()
+    notes = [e for e in flight.doc()["entries"]
+             if e["kind"] == "fleet.imbalance"]
+    assert len(notes) == 1
+    assert f.doc()["imbalance_events"] == 1
+    # balance restored, then skewed again: a second excursion, second note
+    f.note_ingest(1, 800)
+    f.note_merge_done()
+    f.note_ingest(0, 4000)
+    f.note_merge_done()
+    notes = [e for e in flight.doc()["entries"]
+             if e["kind"] == "fleet.imbalance"]
+    assert len(notes) == 2
+
+
+def test_level2_prune_vs_survive_accounting():
+    f = FleetStats(3)
+    f.note_level2(0, False, 0)  # root chip: survives, ships nothing
+    f.note_level2(1, False, 128)
+    f.note_level2(2, True, 0)
+    doc = f.doc()
+    per = {pc["chip"]: pc for pc in doc["per_chip"]}
+    assert per[0]["survived"] == 1 and per[0]["interconnect_rows"] == 0
+    assert per[1]["interconnect_rows"] == 128
+    assert per[2]["pruned"] == 1
+    assert doc["interconnect_rows_total"] == 128
+
+
+def test_labeled_prometheus_families():
+    hub = Telemetry()
+    f = FleetStats(2)
+    f.note_ingest(0, 10)
+    f.note_ingest(1, 30)
+    f.note_merge_done()
+    hub.fleet = f
+    body = hub.render_prometheus()
+    assert '# TYPE skyline_chip_ingest_rows_total counter' in body
+    assert 'skyline_chip_ingest_rows_total{chip="0"} 10' in body
+    assert 'skyline_chip_ingest_rows_total{chip="1"} 30' in body
+    assert '# TYPE skyline_fleet_imbalance_index gauge' in body
+    assert 'skyline_chip_skyline_size{chip="0"}' in body
+
+
+def test_unlabeled_exposition_unchanged_without_fleet():
+    a = Telemetry().render_prometheus()
+    hub = Telemetry()
+    hub.fleet = FleetStats(2)
+    b = hub.render_prometheus()
+    # attaching the plane only ADDS families; every pre-existing line is
+    # byte-identical
+    assert set(a.splitlines()) <= set(b.splitlines())
+
+
+# --------------------------------------------------------------------------
+# sharded engine end-to-end
+# --------------------------------------------------------------------------
+
+
+def _run_sharded(x, chips=2, telemetry=None):
+    cfg = EngineConfig(parallelism=2, dims=x.shape[1], domain_max=1.0,
+                       buffer_size=64, emit_skyline_points=True)
+    eng = ShardedEngine(cfg, chips=chips, telemetry=telemetry)
+    ids = np.arange(x.shape[0], dtype=np.int64)
+    for i in range(0, x.shape[0], 200):
+        eng.process_records(ids[i : i + 200], x[i : i + 200])
+    eng.process_trigger("q,0")
+    (res,) = eng.poll_results()
+    return eng, res
+
+
+def test_sharded_engine_populates_fleet_plane(rng):
+    hub = Telemetry()
+    eng, _res = _run_sharded(gen_points(rng, 600, 2, "uniform"),
+                             telemetry=hub)
+    assert hub.fleet is not None
+    doc = hub.fleet.doc()
+    assert doc["chips"] == 2
+    assert doc["merges"] >= 1
+    assert all(pc["ingest_rows"] > 0 for pc in doc["per_chip"])
+    assert all(pc["flush_rows"] > 0 for pc in doc["per_chip"])
+    # every unpruned level-1 merge stamps a local skyline size
+    assert any(pc["skyline_size"] > 0 for pc in doc["per_chip"])
+    # the root chip's skyline is already device-resident: 0 crossed rows
+    per = {pc["chip"]: pc for pc in doc["per_chip"]}
+    assert per[0]["interconnect_rows"] == 0
+    assert doc["imbalance_index"] >= 1.0
+    # the imbalance block rides the EXPLAIN chips attribution
+    plan = hub.explain.latest()
+    assert plan["chips"]["imbalance"]["imbalance_index"] >= 1.0
+    # sharded_stats carries the doc for /stats readers
+    assert eng.stats()["sharded"]["fleet"]["chips"] == 2
+    # per-chip level-1 child spans + the level-2 interconnect span
+    names = [s["name"] for s in hub.spans.snapshot()]
+    assert "chip_merge" in names and "cross_chip_merge" in names
+
+
+def test_fleet_doc_join_and_http_surface(rng):
+    from skyline_tpu.metrics.httpstats import StatsServer
+
+    hub = Telemetry()
+    eng, _res = _run_sharded(gen_points(rng, 500, 2, "correlated"),
+                             telemetry=hub)
+    doc = fleet_doc(hub, eng.stats())
+    assert doc["enabled"] is True
+    assert doc["chips"] == 2
+    assert doc["last_query"] is not None
+    assert doc["last_query"]["chips"]["chips"] == 2
+    srv = StatsServer(eng.stats, port=0, telemetry=hub)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/fleet", timeout=10
+        ) as r:
+            got = json.load(r)
+        assert got["enabled"] is True and got["chips"] == 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+        assert 'skyline_chip_ingest_rows_total{chip="1"}' in body
+    finally:
+        srv.close()
+
+
+def test_fleet_doc_reports_disabled_on_flat_worker():
+    doc = fleet_doc(Telemetry(), {})
+    assert doc == {"enabled": False, "freshness_wm_ms": None,
+                   "last_query": None}
+
+
+def test_serve_surface_fleet_route(rng):
+    from skyline_tpu.serve import SkylineServer, SnapshotStore
+
+    hub = Telemetry()
+    eng, _res = _run_sharded(gen_points(rng, 400, 2, "uniform"),
+                             telemetry=hub)
+    srv = SkylineServer(SnapshotStore(), stats_cb=eng.stats, port=0,
+                        telemetry=hub)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/fleet", timeout=10
+        ) as r:
+            got = json.load(r)
+        assert got["enabled"] is True and got["chips"] == 2
+    finally:
+        srv.close()
+
+
+@pytest.mark.parametrize("kind", ["uniform", "anti_correlated"])
+def test_byte_identity_with_plane_on_and_off(rng, monkeypatch, kind):
+    x = gen_points(rng, 700, 4, kind)
+    monkeypatch.setenv("SKYLINE_FLEET", "0")
+    eng_off, off = _run_sharded(x, telemetry=Telemetry())
+    assert eng_off.telemetry.fleet is None
+    monkeypatch.setenv("SKYLINE_FLEET", "1")
+    _eng_on, on = _run_sharded(x, telemetry=Telemetry())
+    assert on["skyline_size"] == off["skyline_size"]
+    np.testing.assert_array_equal(
+        np.asarray(on["skyline_points"], dtype=np.float32),
+        np.asarray(off["skyline_points"], dtype=np.float32),
+    )

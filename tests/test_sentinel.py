@@ -1,0 +1,112 @@
+"""Perf-trajectory sentinel (ISSUE 13): rolling-baseline regression watch.
+
+Fabricated artifact trajectories in a tmp dir: a healthy history exits 0,
+an injected regression in the newest round exits 1, absolute rules
+(audit divergence) trip without a baseline, unhealthy multichip rounds
+trip, and backend mixing / unreadable rounds degrade to skips — one bad
+artifact must never blind the watch.
+"""
+
+import json
+
+from skyline_tpu.telemetry import sentinel
+
+
+def _bench(path, r, value, backend="tpu", extra=None):
+    doc = {"parsed": {"value": value, "backend": backend,
+                      "p50_window_latency_ms": 1_000_000.0 / value}}
+    if extra:
+        doc["parsed"].update(extra)
+    (path / f"BENCH_r{r:02d}.json").write_text(json.dumps(doc))
+
+
+def _multichip(path, r, ok=True, skipped=False):
+    (path / f"MULTICHIP_r{r:02d}.json").write_text(
+        json.dumps({"n_devices": 4, "rc": 0 if ok else 1, "ok": ok,
+                    "skipped": skipped, "tail": ""})
+    )
+
+
+def test_healthy_trajectory_exits_zero(tmp_path, capsys):
+    for r, v in enumerate([100.0, 110.0, 105.0, 112.0], start=1):
+        _bench(tmp_path, r, v)
+    _multichip(tmp_path, 1)
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "sentinel: ok" in out
+
+
+def test_slow_drift_regression_exits_one(tmp_path, capsys):
+    # each round is within any pairwise gate, but the newest has lost 40%
+    # against the rolling median — exactly the drift bench_compare misses
+    for r, v in enumerate([100.0, 98.0, 101.0, 99.0, 60.0], start=1):
+        _bench(tmp_path, r, v)
+    assert sentinel.main(["--dir", str(tmp_path), "--threshold", "0.3"]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_absolute_rule_trips_without_baseline(tmp_path, capsys):
+    _bench(tmp_path, 1, 100.0)
+    _bench(tmp_path, 2, 101.0,
+           extra={"audit": {"divergence_total": 1}})
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+    assert "absolute" in capsys.readouterr().out
+
+
+def test_backend_mismatch_is_not_a_regression(tmp_path):
+    # a TPU outage (cpu-fallback round) must not read as a perf collapse
+    for r, v in enumerate([5000.0, 5100.0, 5050.0], start=1):
+        _bench(tmp_path, r, v, backend="tpu")
+    _bench(tmp_path, 4, 80.0, backend="cpu-fallback")
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_unhealthy_multichip_round_exits_one(tmp_path):
+    _bench(tmp_path, 1, 100.0)
+    _bench(tmp_path, 2, 101.0)
+    _multichip(tmp_path, 1, ok=True)
+    _multichip(tmp_path, 2, ok=False)
+    assert sentinel.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_unreadable_round_is_skipped_not_fatal(tmp_path, capsys):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({"no_parsed": 1}))
+    for r, v in enumerate([100.0, 102.0], start=3):
+        _bench(tmp_path, r, v)
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+    err = capsys.readouterr().err
+    assert "skipping" in err
+
+
+def test_empty_directory_is_ok(tmp_path):
+    assert sentinel.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_custom_rules_file(tmp_path):
+    for r, v in enumerate([100.0, 100.0, 100.0], start=1):
+        _bench(tmp_path, r, v, extra={"custom": {"metric": 10.0 * r}})
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        {"label": "custom.metric", "path": ["custom", "metric"],
+         "higher_is_better": True},
+    ]))
+    # 30 vs median(10, 20) = 20: improving, ok
+    assert sentinel.main(
+        ["--dir", str(tmp_path), "--rules", str(rules)]
+    ) == 0
+    rules.write_text(json.dumps([
+        {"label": "custom.metric", "path": ["custom", "metric"],
+         "higher_is_better": False, "threshold": 0.2},
+    ]))
+    # same numbers, direction flipped: +100% vs baseline now regresses
+    assert sentinel.main(
+        ["--dir", str(tmp_path), "--rules", str(rules)]
+    ) == 1
+
+
+def test_usage_errors_exit_two(tmp_path):
+    assert sentinel.main(["--dir", str(tmp_path), "--window", "0"]) == 2
+    bad = tmp_path / "bad_rules.json"
+    bad.write_text("[{\"nope\": 1}]")
+    assert sentinel.main(["--dir", str(tmp_path), "--rules", str(bad)]) == 2

@@ -1,0 +1,209 @@
+"""Workload-drift characterization plane (ISSUE 13).
+
+Classification grid over the repo's own stream generators, drift
+detection on a mid-stream regime switch (exactly one event), sketch
+determinism under a fixed input order, and the byte-identity law: the
+plane on or off never changes a published skyline byte.
+
+Generator ground truth caveat (telemetry/workload.py docstring): the
+unified ``anti_correlated`` generator's wide epsilon band at d >= 4
+produces raw values that genuinely correlate positively (every row
+shares one scale factor), so the anti regime at d >= 4 is pinned with
+``simple_anti_correlated`` — the exact constant-sum variant whose
+anti-correlation survives any dimensionality.
+"""
+
+import numpy as np
+import pytest
+
+from skyline_tpu.metrics.collector import Counters
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry.profiler import FlightRecorder
+from skyline_tpu.telemetry.workload import WorkloadCharacterizer
+from skyline_tpu.workload.generators import generate
+
+
+def characterize(x, batch=1024, **kw):
+    """Feed ``x`` in fixed micro-batches through a small-epoch
+    characterizer (4 epochs over 4096 rows at the defaults here)."""
+    kw.setdefault("epoch_rows", 1024)
+    kw.setdefault("sample_cap", 1024)
+    w = WorkloadCharacterizer(int(x.shape[1]), **kw)
+    for i in range(0, x.shape[0], batch):
+        w.observe(x[i : i + batch])
+    return w
+
+
+def gen(method, d, n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return generate(method, rng, n, d, 0.0, 1000.0).astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# classification grid
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_classifies_uniform(d):
+    w = characterize(gen("uniform", d))
+    r = w.regime()
+    assert r["kind"] == "uniform", r
+    # independent dims: the sum-variance ratio sits near its iid value
+    assert 0.5 <= w.stats()["epochs"][-1]["sum_ratio"] <= 2.0
+
+
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_classifies_correlated(d):
+    w = characterize(gen("correlated", d))
+    r = w.regime()
+    assert r["kind"] == "correlated", r
+    assert r["rho"] > 0.25
+
+
+@pytest.mark.parametrize(
+    "method,d",
+    [
+        ("anti_correlated", 2),  # the unified band is tight at d=2
+        ("simple_anti_correlated", 4),  # exact constant-sum at d >= 4
+        ("simple_anti_correlated", 8),
+    ],
+)
+def test_classifies_anti_correlated(method, d):
+    w = characterize(gen(method, d))
+    r = w.regime()
+    assert r["kind"] == "anti_correlated", (r, w.stats()["epochs"][-1])
+
+
+def test_regime_unknown_before_first_epoch():
+    w = WorkloadCharacterizer(2, epoch_rows=10_000)
+    w.observe(gen("uniform", 2, n=512))
+    assert w.regime() == {"kind": "unknown", "epoch": 0, "drift_total": 0}
+
+
+# --------------------------------------------------------------------------
+# drift detection
+# --------------------------------------------------------------------------
+
+
+def test_mid_stream_switch_trips_exactly_one_drift():
+    # two epochs of uniform, then two of correlated, aligned to the epoch
+    # boundary: the flip fires ONCE at the first correlated close; the
+    # steady epochs on either side must stay quiet
+    w2 = WorkloadCharacterizer(
+        4, counters=Counters(), flight=FlightRecorder(64),
+        epoch_rows=1024, sample_cap=1024,
+    )
+    for i in range(2):
+        w2.observe(gen("uniform", 4, n=1024, seed=i))
+    for i in range(2):
+        w2.observe(gen("correlated", 4, n=1024, seed=10 + i))
+    st = w2.stats()
+    assert st["epochs_closed"] == 4
+    assert st["drift_total"] == 1, st["epochs"]
+    assert st["kind"] == "correlated"
+    assert w2._counters.snapshot()["workload.drift"] == 1
+    notes = [e for e in w2._flight.doc()["entries"]
+             if e["kind"] == "workload.drift"]
+    assert len(notes) == 1
+    assert notes[0]["reason"] == "kind_flip"
+    assert notes[0]["from"] == "uniform" and notes[0]["to"] == "correlated"
+
+
+def test_quantile_shift_drift_without_kind_flip():
+    w = WorkloadCharacterizer(2, epoch_rows=1024, sample_cap=1024,
+                              drift_threshold=0.2)
+    rng = np.random.default_rng(3)
+    # three epochs in [0, 100), then one shifted to [800, 900): same
+    # uniform classification, but the per-dim p50 jumps most of the frozen
+    # sketch range
+    for _ in range(3):
+        w.observe((rng.random((1024, 2)) * 100.0).astype(np.float32))
+    w.observe((rng.random((1024, 2)) * 100.0 + 800.0).astype(np.float32))
+    st = w.stats()
+    assert [e["kind"] for e in st["epochs"]] == ["uniform"] * 4
+    assert st["drift_total"] == 1
+
+
+# --------------------------------------------------------------------------
+# determinism + trajectories
+# --------------------------------------------------------------------------
+
+
+def test_sketch_is_deterministic_under_fixed_input_order():
+    x = gen("correlated", 4, n=8192)
+    a = characterize(x).stats()
+    b = characterize(x).stats()
+    assert a == b
+    # quantiles are real numbers from the frozen-bin sketch (first epoch
+    # carries None while the range freezes)
+    assert a["epochs"][0]["p50"] is None
+    assert all(e["p50"] is not None for e in a["epochs"][1:])
+
+
+def test_note_query_trajectory_and_dominance_rate():
+    w = WorkloadCharacterizer(2, epoch_rows=1024)
+    w.note_query(50, 1000)
+    w.note_query(25, 1000)
+    st = w.stats()
+    assert st["dominance_rate"] == pytest.approx(0.975)
+    assert st["skyline_size"] == 25
+    assert [q["skyline_size"] for q in st["trajectory"]] == [50, 25]
+
+
+def test_large_batch_is_stride_subsampled():
+    w = WorkloadCharacterizer(2, epoch_rows=10_000, sample_cap=128)
+    w.observe(gen("uniform", 2, n=4096))
+    st = w.stats()
+    assert st["rows_seen"] == 4096
+    assert st["rows_sampled"] <= 2 * 128  # ceil-stride may slightly exceed
+
+
+# --------------------------------------------------------------------------
+# engine integration: byte identity, /stats, EXPLAIN, Prometheus
+# --------------------------------------------------------------------------
+
+
+def _run(x, telemetry=None):
+    cfg = EngineConfig(parallelism=2, dims=x.shape[1], domain_max=1000.0,
+                       buffer_size=256, emit_skyline_points=True)
+    eng = SkylineEngine(cfg, telemetry=telemetry)
+    ids = np.arange(x.shape[0], dtype=np.int64)
+    for i in range(0, x.shape[0], 500):
+        eng.process_records(ids[i : i + 500], x[i : i + 500])
+    eng.process_trigger("q,0")
+    (res,) = eng.poll_results()
+    return eng, res
+
+
+def test_engine_byte_identity_with_plane_on_and_off(monkeypatch):
+    x = gen("anti_correlated", 2, n=3000)
+    monkeypatch.setenv("SKYLINE_WORKLOAD", "0")
+    eng_off, off = _run(x)
+    assert eng_off.workload is None
+    assert "workload" not in eng_off.stats()
+    monkeypatch.setenv("SKYLINE_WORKLOAD", "1")
+    eng_on, on = _run(x)
+    assert eng_on.workload is not None
+    assert on["skyline_size"] == off["skyline_size"]
+    np.testing.assert_array_equal(
+        np.asarray(on["skyline_points"], dtype=np.float32),
+        np.asarray(off["skyline_points"], dtype=np.float32),
+    )
+
+
+def test_engine_stats_block_explain_tag_and_metric(monkeypatch):
+    monkeypatch.setenv("SKYLINE_WORKLOAD_EPOCH_ROWS", "512")
+    hub = Telemetry()
+    x = gen("correlated", 2, n=3000)
+    eng, _res = _run(x, telemetry=hub)
+    assert hub.workload is eng.workload
+    st = eng.stats()
+    assert st["workload"]["kind"] == "correlated"
+    assert st["workload"]["epochs_closed"] >= 2
+    plan = hub.explain.latest()
+    assert plan["workload"]["kind"] == "correlated"
+    body = hub.render_prometheus()
+    assert "skyline_workload_drift_total 0" in body
+    assert "skyline_workload_epochs_total" in body
