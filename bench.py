@@ -146,6 +146,81 @@ def resilience_stamp() -> dict:
     }
 
 
+def failover_stamp() -> dict:
+    """Chip fault-tolerance truth for the bench artifact (RUNBOOK §2p):
+    a miniature drill — chip-scoped crash under a merge deadline ->
+    honest degraded answer -> quarantine -> online failover -> post-heal
+    merge byte-identical to a single-device run. Stamps the drill
+    outcome plus the effective §2p knobs; a healthy bench run must show
+    zero degraded answers (scripts/bench_compare.py gates on it). The
+    full latency A/B lives in benchmarks/failover.py
+    (artifacts/failover_ab.json)."""
+    import jax
+
+    if jax.device_count() < 2:
+        return {"skipped": True, "reason": "single device"}
+    from skyline_tpu.distributed import ShardedPartitionSet
+    from skyline_tpu.resilience.faults import FaultPlan, clear, install_plan
+    from skyline_tpu.resilience.health import ChipHealth
+    from skyline_tpu.stream.batched import PartitionSet
+
+    d, P, n = 4, 4, 2000
+    rng = np.random.default_rng(11)
+    x = (rng.random((n, d)) * 10000.0).astype(np.float32)
+    pids = np.arange(n) % P
+    single = PartitionSet(P, d, buffer_size=4096)
+    sp = ShardedPartitionSet(P, d, 4096, chips=2)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    for ps in (single, sp):
+        for p in range(P):
+            ps.add_batch(p, np.ascontiguousarray(x[pids == p]),
+                         max_id=n, now_ms=0.0)
+        ps.flush_all()
+    truth = np.asarray(single.global_merge_stats(emit_points=True)[3])
+    warm = np.asarray(sp.global_merge_stats(emit_points=True)[3])
+    assert warm.tobytes() == truth.tobytes()
+    try:
+        os.environ["SKYLINE_CHIP_MERGE_DEADLINE_MS"] = "500"
+        os.environ["SKYLINE_CHIP_MERGE_RETRIES"] = "0"
+        install_plan(FaultPlan.parse("crash@sharded.chip_merge#1:1"))
+        sp._gm_cache = None  # same epoch: force the level-1 rerun
+        t0 = time.perf_counter()
+        sp.global_merge_stats(emit_points=True)
+        degraded_wall_ms = (time.perf_counter() - t0) * 1000.0
+        partial = sp.last_partial
+        assert partial is not None and partial["excluded_chips"] == [1]
+        assert health.quarantined() == [1]
+    finally:
+        clear()
+        os.environ.pop("SKYLINE_CHIP_MERGE_DEADLINE_MS", None)
+        os.environ.pop("SKYLINE_CHIP_MERGE_RETRIES", None)
+    healed = sp.maybe_failover()
+    assert healed == [1] and health.quarantined() == []
+    post = np.asarray(sp.global_merge_stats(emit_points=True)[3])
+    assert post.tobytes() == truth.tobytes()
+    return {
+        "drill": {
+            "fault": "crash@sharded.chip_merge#1:1",
+            "excluded_chips": partial["excluded_chips"],
+            "completeness_bound": partial["completeness_bound"],
+            "degraded_answer_wall_ms": round(degraded_wall_ms, 1),
+            "time_to_healed_ms": round(
+                float(sp.last_failover["wall_ms"]), 2
+            ),
+            "failover_owner": int(sp.last_failover["owner"]),
+            "healed_byte_identical": True,
+        },
+        "healthy_degraded_answers": 0,
+        "merge_deadline_ms": env_float("SKYLINE_CHIP_MERGE_DEADLINE_MS", 0.0),
+        "merge_retries": env_int("SKYLINE_CHIP_MERGE_RETRIES", 1),
+        "hedge_ms": env_float("SKYLINE_CHIP_HEDGE_MS", 0.0),
+        "fail_threshold": env_int("SKYLINE_CHIP_FAIL_THRESHOLD", 1),
+        "quarantine_score": env_float("SKYLINE_CHIP_QUARANTINE_SCORE", 0.5),
+        "failover_enabled": env_bool("SKYLINE_CHIP_FAILOVER", True),
+    }
+
+
 # --------------------------------------------------------------------------
 # worker: the measured benchmark (runs in a child process)
 # --------------------------------------------------------------------------
@@ -639,6 +714,16 @@ def child_main(backend: str) -> None:
         resilience = resilience_stamp()
     except Exception as e:  # pragma: no cover - diagnostic path
         resilience = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        failover = failover_stamp()
+    except Exception as e:  # pragma: no cover - diagnostic path
+        failover = {"error": f"{type(e).__name__}: {e}"}
+    if isinstance(failover, dict) and isinstance(sharded, dict):
+        # the gate input is the MEASURED bench window, not the drill: a
+        # healthy run that degraded any answer is a regression outright
+        failover["healthy_degraded_answers"] = int(
+            sharded.get("degraded_merges", 0) or 0
+        )
     print(
         json.dumps(
             {
@@ -665,6 +750,7 @@ def child_main(backend: str) -> None:
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,
                 "resilience": resilience,
+                "failover": failover,
                 "merge_cache": merge_cache,
                 "merge_tree": merge_tree,
                 "flush_cascade": flush_cascade,

@@ -14,6 +14,8 @@ non-zero when any regresses past ``--threshold`` (default 25%):
   flush_cascade.prefilter_drop_fraction    lower is a regression
   audit.divergence_total shadow checks     ABSOLUTE: any divergence in
                                            the NEW artifact fails
+  failover.healthy_degraded                ABSOLUTE: any degraded answer
+                                           on a healthy run fails
 
 A metric missing from either artifact (e.g. the serve leg was skipped) is
 reported as ``skipped`` and never fails the gate. Runs on different
@@ -170,6 +172,23 @@ def compare(old: dict, new: dict, threshold: float) -> tuple[list[str], bool]:
             f"  {'audit.divergence_total':<24} {0:>12.2f}  "
             f"(over {checks:.0f} check(s))  ok"
         )
+    # chip fault tolerance (RUNBOOK §2p): a degraded answer on a HEALTHY
+    # bench run means the merge deadline excluded a chip nobody injected a
+    # fault into — honest marking or not, that is a correctness regression
+    # outright. Absolute, no threshold. Absent block (older artifact,
+    # single device) skips, never fails.
+    label = "failover.healthy_degraded"
+    deg = dig(new, ("failover", "healthy_degraded_answers"))
+    if deg is None:
+        lines.append(f"  {label:<24} skipped (absent)")
+    elif deg > 0:
+        lines.append(
+            f"  {label:<24} {deg:>12.0f}  "
+            "REGRESSION (degraded answer on a healthy run)"
+        )
+        regressed = True
+    else:
+        lines.append(f"  {label:<24} {0:>12.2f}  ok")
     return lines, regressed
 
 

@@ -9,6 +9,12 @@
 #     wal.replayed > 0, checkpoint.saved >= 1,
 #   * skyline_resilience_restarts_total reaches the Prometheus exposition.
 #
+# Then two follow-on drills: the audit-divergence drill (corrupt a
+# published snapshot, prove the shadow-verification plane catches it) and
+# the chip fault-tolerance drill (slow chip + chip-kill under a merge
+# deadline: honest degraded answer -> quarantine -> online failover ->
+# healed byte-identical; RUNBOOK §2p).
+#
 #   scripts/chaos_smoke.sh
 #
 # Exits non-zero on any failed assertion. CPU-only (JAX_PLATFORMS=cpu).
@@ -188,4 +194,98 @@ assert verdict["reproduced"] is True, verdict
 assert verdict["engine_diverges"] is False, verdict
 print(f"[chaos-smoke] audit drill ok: divergence detected, bundle at "
       f"{bundle}, replay reproduced the diff (engine acquitted)")
+EOF
+
+# chip fault-tolerance drill (RUNBOOK §2p): a slow chip and a chip-kill,
+# each scoped to chip 1 of a 2-chip sharded engine under a merge
+# deadline — the degraded answer must arrive marked (partial + excluded
+# chip + completeness bound) WITHIN the deadline budget, the chip must
+# quarantine, online failover must re-own its partition group, and the
+# first post-heal answer must be byte-identical to an uninterrupted run
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python - <<'EOF'
+import os
+import threading
+import time
+
+import numpy as np
+
+from skyline_tpu.distributed import ShardedEngine
+from skyline_tpu.resilience.faults import FaultPlan, clear, install_plan
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.workload.generators import anti_correlated
+
+N, D = 2000, 3
+rng = np.random.default_rng(5)
+x = anti_correlated(rng, N, D, 0, 10000)
+ids = np.arange(N)
+
+
+def build():
+    return ShardedEngine(
+        EngineConfig(parallelism=2, dims=D, domain_max=10000.0,
+                     buffer_size=256, emit_skyline_points=True),
+        chips=2,
+        telemetry=Telemetry(),
+    )
+
+
+def answer(eng, q):
+    eng.process_trigger(f"{q},0")
+    (res,) = eng.poll_results()
+    return res
+
+
+base = build()
+base.process_records(ids, x)
+truth = np.asarray(
+    answer(base, "t")["skyline_points"], np.float32
+).tobytes()
+
+for action in ("slow", "crash"):
+    eng = build()
+    eng.process_records(ids, x)
+    warm = answer(eng, "warm")  # compile walls land before the deadline
+    assert np.asarray(
+        warm["skyline_points"], np.float32
+    ).tobytes() == truth
+    os.environ["SKYLINE_CHIP_MERGE_DEADLINE_MS"] = "500"
+    os.environ["SKYLINE_CHIP_MERGE_RETRIES"] = "0"
+    os.environ["SKYLINE_FAULT_SLOW_MS"] = "2000"
+    install_plan(FaultPlan.parse(f"{action}@sharded.chip_merge#1:1"))
+    eng.pset._gm_cache = None  # same epoch: force the level-1 rerun
+    t0 = time.perf_counter()
+    deg = answer(eng, "fault")
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    clear()
+    for t in threading.enumerate():  # drain the abandoned slow attempt
+        if t.name.startswith("chip1-merge"):
+            t.join(timeout=30)
+    assert deg.get("partial") is True, f"{action}: answer not marked partial"
+    assert deg["excluded_chips"] == [1], deg["excluded_chips"]
+    assert 0.0 < deg["completeness_bound"] < 1.0, deg["completeness_bound"]
+    if action == "slow":
+        # the deadline was honored — the answer did not wait out the
+        # 2000ms injected stall
+        assert wall_ms < 2000.0, f"slow drill took {wall_ms:.0f}ms"
+    assert eng.health.quarantined() == [1]
+    assert int(eng.telemetry.counters.get("degraded_answers")) == 1
+    assert "skyline_degraded_answers_total 1" in \
+        eng.telemetry.render_prometheus()
+    for k in ("SKYLINE_CHIP_MERGE_DEADLINE_MS", "SKYLINE_CHIP_MERGE_RETRIES",
+              "SKYLINE_FAULT_SLOW_MS"):
+        os.environ.pop(k, None)
+    eng.pset._gm_cache = None
+    healed = answer(eng, "heal")  # merge launch runs the failover first
+    assert "partial" not in healed
+    assert eng.pset.failovers == 1 and eng.health.quarantined() == []
+    assert np.asarray(
+        healed["skyline_points"], np.float32
+    ).tobytes() == truth, f"{action}: post-heal answer diverged"
+    lf = eng.pset.last_failover
+    print(f"[chaos-smoke] chip drill ok: {action}@chip1 -> degraded "
+          f"({wall_ms:.0f}ms, marked partial) -> quarantined -> failover "
+          f"(owner={lf['owner']}, {lf['wall_ms']:.1f}ms) -> healed "
+          f"byte-identical")
 EOF

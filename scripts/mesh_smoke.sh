@@ -14,7 +14,10 @@
 #     series non-zero, imbalance gauge present, chip 0 ships 0
 #     interconnect rows; the flat worker answers {"enabled": false},
 #   * /metrics carries the labeled skyline_chip_* families and the
-#     skyline_workload_drift_total counter.
+#     skyline_workload_drift_total counter,
+#   * the chip-health join rides /fleet with every chip healthy and the
+#     skyline_degraded_answers_total counter exposed at 0 on a clean run
+#     (RUNBOOK 2p).
 #
 #   scripts/mesh_smoke.sh
 #
@@ -117,6 +120,18 @@ assert 'skyline_chip_ingest_rows_total{chip="0"}' in metrics, \
 assert "skyline_fleet_imbalance_index" in metrics, metrics[-400:]
 assert "skyline_workload_drift_total" in metrics, \
     "workload drift counter missing from /metrics"
+
+# chip fault tolerance (RUNBOOK §2p): the health join rides /fleet — a
+# clean run reports every chip healthy with nothing quarantined — and the
+# honest-degradation counter is exposed (and zero) even when no answer
+# has ever degraded, so dashboards can alert on the first increment
+hdoc = fleet["health"]
+assert hdoc is not None and hdoc["chips"] == 4, hdoc
+assert hdoc["quarantined"] == [], \
+    f"clean run quarantined chips: {hdoc['quarantined']}"
+assert all(pc["status"] == "healthy" for pc in hdoc["per_chip"]), hdoc
+assert "skyline_degraded_answers_total 0" in metrics, \
+    "degraded-answer counter missing from /metrics on a clean run"
 
 print(f"[mesh-smoke] identity ok: g={g_sh}, sha256 {d_sh[:16]}… identical "
       "flat vs 4 chips")

@@ -113,6 +113,26 @@ KNOBS: tuple[Knob, ...] = (
        "merge (every two-level merge), checkpoint (checkpoint time only), "
        "off (no chip WAL plane)", "engine/sharded",
        choices=("merge", "checkpoint", "off"), runbook="§2n"),
+    _k("SKYLINE_CHIP_MERGE_DEADLINE_MS", "float", 0.0,
+       "per-chip level-1 merge deadline in the sharded tournament; a chip "
+       "that misses it is excluded and the answer publishes marked "
+       "partial (0 = unbounded, the byte-identity default)",
+       "engine/sharded", runbook="§2p"),
+    _k("SKYLINE_CHIP_MERGE_RETRIES", "int", 1,
+       "bounded retries per chip inside the merge deadline (transient "
+       "faults get a second chance before exclusion)", "engine/sharded",
+       runbook="§2p"),
+    _k("SKYLINE_CHIP_MERGE_BACKOFF_MS", "float", 50.0,
+       "base backoff between per-chip merge retries (doubles per "
+       "attempt)", "engine/sharded", runbook="§2p"),
+    _k("SKYLINE_CHIP_HEDGE_MS", "float", 0.0,
+       "straggler hedge: launch a second attempt for a chip still "
+       "running after this many ms (0 = no hedging)", "engine/sharded",
+       runbook="§2p"),
+    _k("SKYLINE_CHIP_FAILOVER", "bool", True,
+       "online partition-group failover: a quarantined chip's group is "
+       "re-owned by a healthy chip at the next merge launch",
+       "engine/sharded", runbook="§2p"),
     _k("SKYLINE_QUERY_OVERLAP", "bool", True,
        "overlapped query sync: launch the global merge at trigger time, "
        "harvest at emission", "engine", runbook="§2f"),
@@ -246,6 +266,15 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SERVE_READ_CACHE", "int", 64,
        "serialized-response LRU entries (0 disables)", "job flag",
        runbook="§2e", job_field="serve_read_cache"),
+    _k("SKYLINE_SERVE_READY_TIMEOUT_S", "float", 10.0,
+       "startup wait for the serving loop to bind its socket", "serve",
+       runbook="§2d"),
+    _k("SKYLINE_SERVE_SHUTDOWN_TIMEOUT_S", "float", 10.0,
+       "close() wait for the serving loop thread to drain", "serve",
+       runbook="§2d"),
+    _k("SKYLINE_SERVE_HEADER_TIMEOUT_S", "float", 10.0,
+       "per-connection wait for a complete request header block", "serve",
+       runbook="§2d"),
     _k("SKYLINE_TRACE_OUT", "str", "",
        "write the span ring as Chrome trace-event JSON on shutdown",
        "job flag", runbook="§2b", job_field="trace_out"),
@@ -276,8 +305,30 @@ KNOBS: tuple[Knob, ...] = (
     # -- resilience runtime (skyline_tpu/resilience) -----------------------
     _k("SKYLINE_FAULT_PLAN", "str", None,
        "deterministic fault-injection plan, e.g. crash@flush.pre_merge:3 "
-       "(comma-separated action@point:nth clauses; test/chaos use only)",
-       "resilience", runbook="§2i"),
+       "(comma-separated action@point:nth clauses; actions: crash, exit, "
+       "corrupt, slow, hang; chip-scopable as point#chip; test/chaos use "
+       "only)", "resilience", runbook="§2i"),
+    _k("SKYLINE_FAULT_SLOW_MS", "float", 250.0,
+       "injected delay of a slow@ fault clause", "resilience",
+       runbook="§2p"),
+    _k("SKYLINE_FAULT_HANG_S", "float", 3600.0,
+       "cap on a hang@ fault clause (the hung thread parks on an event "
+       "released by faults.clear())", "resilience", runbook="§2p"),
+    _k("SKYLINE_CHIP_FAIL_THRESHOLD", "int", 1,
+       "consecutive per-chip merge failures/timeouts before quarantine",
+       "resilience", runbook="§2p"),
+    _k("SKYLINE_CHIP_QUARANTINE_SCORE", "float", 0.5,
+       "health score below which a chip quarantines (scores decay on "
+       "failure/straggle, recover on clean merges)", "resilience",
+       runbook="§2p"),
+    _k("SKYLINE_CHIP_STRAGGLER_FACTOR", "float", 4.0,
+       "a chip's level-1 wall beyond this multiple of the peer-EMA "
+       "median counts as a straggle (after a warmup of clean merges)",
+       "resilience", runbook="§2p"),
+    _k("SKYLINE_CHIP_HEARTBEAT_MS", "float", 30000.0,
+       "per-chip heartbeat staleness limit for the health tick "
+       "(relative: the whole fleet idling does not quarantine anyone)",
+       "resilience", runbook="§2p"),
     _k("SKYLINE_SUPERVISOR_MAX_RESTARTS", "int", 5,
        "supervised-restart budget before giving up", "resilience",
        runbook="§2i"),
@@ -360,6 +411,9 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SLO_AUDIT_DIVERGENCE", "float", 0.0001,
        "SLO target: max fraction of audited snapshots diverging from the "
        "host oracle", "telemetry/slo", runbook="§2l"),
+    _k("SKYLINE_SLO_DEGRADED_ANSWERS", "float", 0.01,
+       "SLO target: max fraction of answered queries published "
+       "chip-degraded (marked partial)", "telemetry/slo", runbook="§2p"),
     _k("SKYLINE_FLEET", "bool", True,
        "per-chip fleet plane on the sharded engine: skyline_chip_* "
        "labeled metric families, imbalance index + skew ring, per-chip "

@@ -124,6 +124,16 @@ class Auditor:
             return None
         tel = self.telemetry
         trace_id = snap.meta.get("trace_id")
+        if snap.meta.get("partial"):
+            # a chip-degraded snapshot (RUNBOOK §2p) is an HONEST subset —
+            # by construction it differs from the full oracle, so checking
+            # it would count marked degradation as a lying answer
+            tel.inc("audit.skips")
+            tel.flight.note(
+                "audit.skip", reason="partial_snapshot",
+                version=int(snap.version), trace_id=trace_id,
+            )
+            return None
         source_key = snap.source_key
         epoch_key = self.engine.pset.epoch_key
         if source_key is not None and source_key != epoch_key:

@@ -936,6 +936,16 @@ class SkylineWorker:
                 auditor = getattr(self.engine, "auditor", None)
                 if auditor is not None:
                     auditor.maybe_canary()
+                # idle ticks also drive the chip-health plane (RUNBOOK
+                # §2p): staleness scoring plus failover of any chip that
+                # quarantined since the last merge — recovery must not
+                # wait for organic traffic
+                health = getattr(self.engine, "health", None)
+                if health is not None:
+                    health.tick()
+                    pset = getattr(self.engine, "pset", None)
+                    if pset is not None and hasattr(pset, "maybe_failover"):
+                        pset.maybe_failover()
                 time.sleep(idle_sleep_s)
             else:
                 idle_since = None

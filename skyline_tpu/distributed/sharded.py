@@ -40,6 +40,7 @@ exercises the real merge topology without a TPU.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 
 import jax
@@ -48,12 +49,14 @@ import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
 from skyline_tpu.ops.dispatch import (
+    chip_failover_enabled,
+    chip_merge_deadline_ms,
     chip_prune_enabled,
     fleet_enabled,
     merge_cache_enabled,
 )
 from skyline_tpu.parallel.chips import chip_devices
-from skyline_tpu.resilience.faults import fault_point
+from skyline_tpu.resilience.faults import InjectedCrash, fault_point
 from skyline_tpu.stream.batched import PartitionSet, PartitionView
 from skyline_tpu.stream.engine import SkylineEngine
 from skyline_tpu.stream.window import (
@@ -90,6 +93,7 @@ class _ShardedMergeHandle:
         "root_vals",
         "explain",
         "chip_info",
+        "partial",
     )
 
     def __init__(self):
@@ -99,6 +103,10 @@ class _ShardedMergeHandle:
         self.root_vals = None
         self.explain = None
         self.chip_info = None
+        # set when chips were EXCLUDED from this merge (deadline/failure):
+        # {"excluded_chips", "reasons", "completeness_bound",
+        #  "excluded_records"} — rides to the engine as a degraded answer
+        self.partial = None
 
     def ready(self) -> bool:
         if self.cached:
@@ -148,6 +156,9 @@ class ShardedPartitionSet:
         self.group_size = num_partitions // chips
         self.flush_policy = flush_policy
         self.overlap_rows = overlap_rows
+        # kept so failover can rebuild a group with ctor-identical shape
+        self._initial_capacity = initial_capacity
+        self._window_capacity = window_capacity
         self.mesh = None  # the engine's mesh-vs-device dispatch stays live
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self._devices = chip_devices(chips)
@@ -201,6 +212,20 @@ class ShardedPartitionSet:
         # chip-local WAL plane (resilience/chip_wal.py), worker-attached
         self._chip_wal = None
         self._barrier_seq = 0
+        # chip-fault-tolerance plane (RUNBOOK §2p): health scores drive
+        # quarantine; the deadline-bounded level 1 runs each chip's merge
+        # on a watchdog thread serialized by that chip's lock (a
+        # PartitionSet is not thread-safe; an abandoned attempt must
+        # never interleave with a retry or a later merge on the same
+        # group)
+        self._health = None
+        self._chip_locks = [threading.Lock() for _ in range(chips)]
+        self.degraded_merges = 0
+        self.failovers = 0
+        self.last_failover: dict | None = None
+        # the most recent harvest's partial marker (None = full answer);
+        # the engine reads this right after harvest to mark the result
+        self.last_partial: dict | None = None
 
     # -- chip addressing ---------------------------------------------------
 
@@ -289,6 +314,12 @@ class ShardedPartitionSet:
         group consistency against."""
         self._chip_wal = plane
 
+    def attach_health(self, health) -> None:
+        """Attach a ``resilience.health.ChipHealth`` supervisor: merge
+        outcomes feed its scores, and quarantine decisions drive the
+        deadline-bounded merge's exclusions plus ``maybe_failover``."""
+        self._health = health
+
     def _fnote(self, kind: str, **fields) -> None:
         if self._flight is not None:
             self._flight.note(kind, **fields)
@@ -365,7 +396,20 @@ class ShardedPartitionSet:
         """Launch the two-level merge. Level 1 (intra-chip trees) harvests
         synchronously — each chip's stats sync sizes its cross-chip leaf —
         but the level-2 pairwise kernels and the packed stats transfer
-        stay in flight until ``global_merge_harvest``."""
+        stay in flight until ``global_merge_harvest``.
+
+        With ``SKYLINE_CHIP_MERGE_DEADLINE_MS`` set, each chip's level-1
+        merge is deadline-bounded (watchdog thread + retry/hedge ladder,
+        see ``_bounded_level1``); a chip that exhausts its budget is
+        excluded and the handle carries a ``partial`` marker — the
+        surviving-chips skyline is a sound SUBSET of the true answer
+        (the global skyline decomposes over chip-local skylines), and
+        its missing mass is bounded by the excluded chips' record share
+        (RUNBOOK §2p)."""
+        # heal before measuring: a quarantined chip's group is re-owned by
+        # a healthy chip NOW, so this merge — and every later one — runs
+        # full-strength instead of repeatedly degrading
+        self.maybe_failover()
         h = _ShardedMergeHandle()
         h.emit_points = emit_points
         h.key = self.epoch_key
@@ -408,41 +452,34 @@ class ShardedPartitionSet:
         chip_summary: list[np.ndarray | None] = []
         want_prune = chip_prune_enabled() and C > 1
         trace_id = h.explain.trace_id if h.explain is not None else None
+        deadline_ms = chip_merge_deadline_ms()
+        bounded = deadline_ms > 0 and C > 1
+        failed: list[dict] = []
         for c, chip in enumerate(self._chips):
             t0 = time.perf_counter_ns()
-            with self._dev(c):
-                fault_point("sharded.chip_merge")
-                ch = chip.global_merge_launch(False)
-                counts_c, surv_c, g_c, _ = chip.global_merge_harvest(ch)
-                chip_counts.append(counts_c)
-                chip_surv.append(surv_c)
-                chip_g.append(g_c)
-                if g_c > 0:
-                    w = _active_bucket(max(g_c, 1))
-                    pts = chip.merge_points_device(ch, w)
-                    chip_pts.append(pts)
-                    if want_prune:
-                        # the chip root as a one-partition stack: its
-                        # (1, 2d+2) [min_corner | witness | sums] summary
-                        # is the whole cross-chip prune input — 2d+2 floats
-                        # per chip instead of the root buffer
-                        chip_summary.append(
-                            np.asarray(
-                                partition_summaries_device(
-                                    pts[None],
-                                    jnp.asarray(
-                                        np.array([g_c], dtype=np.int32)
-                                    ),
-                                    w,
-                                )
-                            )[0]
-                        )
-                    else:
-                        chip_summary.append(None)
-                else:
-                    chip_pts.append(None)
-                    chip_summary.append(None)
+            if bounded:
+                r = self._bounded_level1(
+                    c, chip, want_prune, deadline_ms, failed
+                )
+            else:
+                fault_point("sharded.chip_merge", chip=c)
+                r = self._level1_chip(c, chip, want_prune)
             t1 = time.perf_counter_ns()
+            if r is None:
+                # excluded this merge: the group contributes nothing and
+                # the answer publishes marked partial (RUNBOOK §2p)
+                chip_counts.append(np.zeros(G, dtype=np.int64))
+                chip_surv.append(np.zeros(G, dtype=np.int64))
+                chip_g.append(0)
+                chip_pts.append(None)
+                chip_summary.append(None)
+                continue
+            counts_c, surv_c, g_c, pts, summary = r
+            chip_counts.append(counts_c)
+            chip_surv.append(surv_c)
+            chip_g.append(g_c)
+            chip_pts.append(pts)
+            chip_summary.append(summary)
             if self._spans is not None:
                 # level-1 child span: /trace shows which chip's local
                 # tournament the merge wall went to
@@ -452,6 +489,33 @@ class ShardedPartitionSet:
                 )
             if self._fleet is not None:
                 self._fleet.note_level1(c, g_c, (t1 - t0) / 1e6)
+            if self._health is not None:
+                self._health.note_merge_ok(c, (t1 - t0) / 1e6)
+        if failed:
+            lost = sum(
+                int(self.records_seen[f["chip"] * G : (f["chip"] + 1) * G].sum())
+                for f in failed
+            )
+            total = int(self.records_seen.sum())
+            h.partial = {
+                "excluded_chips": [f["chip"] for f in failed],
+                "reasons": [f["reason"] for f in failed],
+                "excluded_records": lost,
+                # record-mass bound from the facade ledger: the surviving
+                # answer is a subset of the truth covering at least this
+                # fraction of every record ingested so far
+                "completeness_bound": (
+                    round((total - lost) / total, 6) if total else 1.0
+                ),
+            }
+            self.degraded_merges += 1
+            self._inc("sharded.degraded")
+            self._fnote(
+                "sharded.degraded",
+                excluded=h.partial["excluded_chips"],
+                reasons=h.partial["reasons"],
+                bound=h.partial["completeness_bound"],
+            )
         concat_counts = np.concatenate(chip_counts)
         alive = np.array([g > 0 for g in chip_g], dtype=bool)
         considered = int(alive.sum())
@@ -560,6 +624,225 @@ class ShardedPartitionSet:
         )
         return h
 
+    def _level1_chip(self, c: int, chip, want_prune: bool):
+        """One chip's intra-chip tournament, device-pinned: harvest stats,
+        materialize the padded root points, and (under the chip prune)
+        the (2d+2) summary row. Returns ``(counts, surv, g, pts,
+        summary)``."""
+        with self._dev(c):
+            ch = chip.global_merge_launch(False)
+            counts_c, surv_c, g_c, _ = chip.global_merge_harvest(ch)
+            pts = None
+            summary = None
+            if g_c > 0:
+                w = _active_bucket(max(g_c, 1))
+                pts = chip.merge_points_device(ch, w)
+                if want_prune:
+                    # the chip root as a one-partition stack: its
+                    # (1, 2d+2) [min_corner | witness | sums] summary
+                    # is the whole cross-chip prune input — 2d+2 floats
+                    # per chip instead of the root buffer
+                    summary = np.asarray(
+                        partition_summaries_device(
+                            pts[None],
+                            jnp.asarray(np.array([g_c], dtype=np.int32)),
+                            w,
+                        )
+                    )[0]
+        return counts_c, surv_c, g_c, pts, summary
+
+    def _bounded_level1(
+        self, c: int, chip, want_prune: bool, deadline_ms: float,
+        failed: list,
+    ):
+        """Deadline-bounded level 1 for one chip: the merge runs on a
+        watchdog thread with a per-chip budget, a bounded retry ladder
+        (``SKYLINE_CHIP_MERGE_RETRIES`` extra attempts under exponential
+        ``SKYLINE_CHIP_MERGE_BACKOFF_MS``), and optional straggler
+        hedging (``SKYLINE_CHIP_HEDGE_MS`` > 0 races a second attempt;
+        first result wins). Returns the level-1 tuple, or ``None`` once
+        the budget is exhausted — the chip is excluded from THIS answer
+        and ChipHealth decides quarantine.
+
+        Thread discipline: ``fault_point`` fires OUTSIDE the chip lock
+        (an injected hang parks the attempt thread without wedging the
+        lock, so hedges and retries stay live), while the merge itself
+        runs INSIDE it — a ``PartitionSet`` is not thread-safe, so an
+        abandoned attempt finishing late must never interleave with a
+        sibling or a later merge on the same group. A genuinely wedged
+        kernel holds the lock; every rescue then blocks behind it and
+        the deadline exclusion is the only way out, which is the point.
+
+        An unscoped ``InjectedCrash`` models a PROCESS death and
+        re-raises on the calling thread; a chip-scoped one models this
+        chip failing and counts against it."""
+        from skyline_tpu.analysis.registry import env_float, env_int
+
+        t_end = time.monotonic() + deadline_ms / 1000.0
+        retries = max(0, env_int("SKYLINE_CHIP_MERGE_RETRIES", 1))
+        backoff_s = (
+            max(0.0, env_float("SKYLINE_CHIP_MERGE_BACKOFF_MS", 50.0)) / 1000.0
+        )
+        hedge_s = max(0.0, env_float("SKYLINE_CHIP_HEDGE_MS", 0.0)) / 1000.0
+        attempt = 0
+        while True:
+            done = threading.Event()
+            slot: dict = {}
+
+            def run(done=done, slot=slot):
+                try:
+                    fault_point("sharded.chip_merge", chip=c)
+                    with self._chip_locks[c]:
+                        if done.is_set():
+                            return  # a sibling attempt already won
+                        r = self._level1_chip(c, chip, want_prune)
+                except BaseException as e:  # InjectedCrash included
+                    slot.setdefault("err", e)
+                else:
+                    slot.setdefault("ok", r)
+                finally:
+                    done.set()
+
+            threading.Thread(
+                target=run, daemon=True, name=f"chip{c}-merge-a{attempt}"
+            ).start()
+            remaining = t_end - time.monotonic()
+            if hedge_s > 0 and remaining > hedge_s and not done.wait(hedge_s):
+                # straggler hedge: whichever attempt takes the chip lock
+                # first computes; the loser sees done set and bows out
+                threading.Thread(
+                    target=run, daemon=True, name=f"chip{c}-merge-hedge"
+                ).start()
+            finished = done.wait(max(0.0, t_end - time.monotonic()))
+            if finished and "ok" in slot:
+                return slot["ok"]
+            if finished and "err" in slot:
+                e = slot["err"]
+                if isinstance(e, InjectedCrash) and not e.chip_scoped:
+                    raise e  # process death: never absorbed as a chip fault
+                attempt += 1
+                if attempt <= retries and time.monotonic() + backoff_s < t_end:
+                    time.sleep(backoff_s)
+                    backoff_s *= 2
+                    continue
+                reason = f"{type(e).__name__}: {e}"
+                if self._health is not None:
+                    self._health.note_merge_error(c, reason)
+            else:
+                reason = f"deadline {deadline_ms:.0f}ms exceeded"
+                if self._health is not None:
+                    self._health.note_merge_timeout(c, deadline_ms)
+            failed.append({"chip": c, "reason": reason})
+            self._fnote("sharded.chip_excluded", chip=c, reason=reason)
+            return None
+
+    # -- online partition-group failover -------------------------------------
+
+    def maybe_failover(self) -> list[int]:
+        """Re-own every quarantined chip's partition group onto a healthy
+        owner (called at merge-launch entry and from worker idle ticks).
+        Returns the chips healed. No-op without an attached ChipHealth,
+        with ``SKYLINE_CHIP_FAILOVER=0``, or when nothing is
+        quarantined."""
+        if self._health is None or not chip_failover_enabled():
+            return []
+        quarantined = self._health.quarantined()
+        if not quarantined:
+            return []
+        healed = []
+        for c in quarantined:
+            owner = next(
+                (
+                    o
+                    for o in range(self.chips)
+                    if o != c and not self._health.is_quarantined(o)
+                ),
+                None,
+            )
+            if owner is None:
+                self._fnote("sharded.failover_stalled", quarantined=quarantined)
+                break  # no healthy owner left; stay degraded
+            self.failover(c, owner)
+            healed.append(c)
+        return healed
+
+    def failover(self, c: int, owner: int | None = None) -> None:
+        """Re-own chip ``c``'s partition group on ``owner``'s device —
+        chip-local, no stop-the-world: only this group's state moves,
+        every other chip keeps serving.
+
+        The group's per-partition state (resident skylines + pending
+        rows, exactly what checkpoint restore carries) round-trips
+        through ``audit_state`` into a fresh ctor-identical
+        ``PartitionSet`` pinned to the owner's device, and
+        ``restore_all``'s byte-faithful contract (the crash-replay tests'
+        invariant) makes the healed group merge byte-identically to an
+        uninterrupted run. The chip WAL supplies the replay-window
+        accounting: ``failover_window(c)`` reports the chip's journal
+        records since the last common barrier — the chip-local segment a
+        physical re-owner must re-apply — and the newest journaled epoch
+        digest, recorded in ``last_failover`` for the drill to verify
+        currency against."""
+        if owner is None:
+            owner = next(
+                (
+                    o
+                    for o in range(self.chips)
+                    if o != c
+                    and (
+                        self._health is None
+                        or not self._health.is_quarantined(o)
+                    )
+                ),
+                None,
+            )
+            if owner is None:
+                raise RuntimeError(f"no healthy owner for chip {c}")
+        t0 = time.perf_counter_ns()
+        window = None
+        if self._chip_wal is not None:
+            try:
+                window = self._chip_wal.failover_window(c)
+            except (OSError, ValueError, KeyError):
+                window = None  # journal unreadable: heal without the audit
+        old = self._chips[c]
+        old_epoch = epoch_hex(old.epoch_key)
+        with self._dev(c):
+            skies, pendings = old.audit_state()
+        with jax.default_device(self._devices[owner]):
+            grp = PartitionSet(
+                self.group_size,
+                self.dims,
+                self.buffer_size,
+                initial_capacity=self._initial_capacity,
+                tracer=self.tracer,
+                flush_policy=self.flush_policy,
+                overlap_rows=self.overlap_rows,
+                window_capacity=self._window_capacity,
+                counters=self._counters,
+            )
+            grp.restore_all(skies, pendings)
+        self._chips[c] = grp
+        self._devices[c] = self._devices[owner]
+        grp.attach_observability(profiler=self._profiler, flight=self._flight)
+        self._gm_cache = None  # the cached two-level result is stale now
+        wall_ms = (time.perf_counter_ns() - t0) / 1e6
+        self.failovers += 1
+        self.last_failover = {
+            "chip": c,
+            "owner": owner,
+            "wall_ms": round(wall_ms, 3),
+            "epoch": old_epoch,
+            "wal_window": window,
+        }
+        self._inc("sharded.failovers")
+        self._fnote(
+            "sharded.failover", chip=c, owner=owner,
+            wall_ms=round(wall_ms, 3), wal_window=window,
+        )
+        if self._health is not None:
+            self._health.heal(c)
+
     def _note_merge_info(
         self, h, chip_g, considered, pruned, witness_of, survivors, levels,
         cand,
@@ -593,6 +876,8 @@ class ShardedPartitionSet:
             "candidates_per_level": cand,
             "per_chip": per_chip,
         }
+        if h.partial is not None:
+            info["degraded"] = h.partial
         if self._fleet is not None:
             for c in np.flatnonzero(pruned):
                 self._fleet.note_level2(int(c), True, 0)
@@ -628,10 +913,15 @@ class ShardedPartitionSet:
                 "dirty": list(range(self.num_partitions)),
                 "clean": [],
             }
+            if h.partial is not None:
+                h.explain.merge["partial"] = True
             h.explain.chips = info
 
     def global_merge_harvest(self, handle):
         h = handle
+        # the engine reads this right after harvest: None = full answer,
+        # a dict = mark the emitted result/snapshot degraded (§2p)
+        self.last_partial = h.partial
         if h.cached:
             return h.result
         P = self.num_partitions
@@ -642,7 +932,10 @@ class ShardedPartitionSet:
         g = int(svec[2 * P])
         if h.explain is not None and h.explain.merge is not None:
             h.explain.merge["skyline_size"] = g
-        if self._chip_wal is not None:
+        if self._chip_wal is not None and h.partial is None:
+            # a degraded merge never stamps a barrier: barrier records
+            # certify an ALL-chips consistent cut, and the failover replay
+            # window is measured from the last such cut
             self._barrier_seq += 1
             self._chip_wal.merge_barrier(
                 self._barrier_seq,
@@ -653,7 +946,7 @@ class ShardedPartitionSet:
                 ).sum(axis=1))],
             )
         pts = None
-        if h.use_cache:
+        if h.use_cache and h.partial is None:
             gcap = 2 * _next_pow2(max(g, 1))
             pts_dev = tree_points_device(h.root_vals, gcap)
             self._gm_cache = {
@@ -755,11 +1048,16 @@ class ShardedPartitionSet:
             },
             "devices": [str(d) for d in self._devices],
             "last": self.last_chip_info,
+            "degraded_merges": self.degraded_merges,
+            "failovers": self.failovers,
+            "last_failover": self.last_failover,
         }
         if self._fleet is not None:
             out["fleet"] = self._fleet.doc()
         if self._chip_wal is not None:
             out["chip_wal"] = self._chip_wal.stats()
+        if self._health is not None:
+            out["health"] = self._health.doc()
         return out
 
 
@@ -809,6 +1107,15 @@ class ShardedEngine(SkylineEngine):
             fleet=fleet,
             spans=telemetry.spans if telemetry is not None else None,
         )
+        # chip-fault-tolerance plane (RUNBOOK §2p): merge outcomes feed
+        # the scores, quarantine drives exclusion + online failover; the
+        # hub reference serves the /health chip block
+        from skyline_tpu.resilience.health import ChipHealth
+
+        self.health = ChipHealth(self.mesh_chips, telemetry=telemetry)
+        self.pset.attach_health(self.health)
+        if telemetry is not None:
+            telemetry.health = self.health
 
     def stats(self, include_skyline_counts: bool = False) -> dict:
         out = super().stats(include_skyline_counts)

@@ -32,6 +32,8 @@ Endpoints:
                 imbalance index + skew score, freshness watermark, last
                 EXPLAIN chip attribution (sharded workers; a flat worker
                 reports {"enabled": false})
+  GET /health   chip-health block (RUNBOOK §2p): per-chip score/status +
+                quarantine state (flat workers report {"enabled": false})
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -216,6 +218,8 @@ class StatsServer:
                             handler._reply(200, outer._fleet_doc())
                         except Exception as e:
                             handler._reply(500, {"error": str(e)})
+                elif path == "/health":
+                    handler._reply(200, outer._health_doc())
                 elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
@@ -285,6 +289,22 @@ class StatsServer:
         from skyline_tpu.telemetry import fleet_doc
 
         return fleet_doc(self.telemetry, self._callback())
+
+    def _health_doc(self) -> dict:
+        """The /health chip block (RUNBOOK §2p): per-chip health scores +
+        quarantine state. Probe-friendly on flat workers — ``enabled`` is
+        false and the chip list is absent when no ChipHealth is attached."""
+        health = (
+            getattr(self.telemetry, "health", None)
+            if self.telemetry is not None
+            else None
+        )
+        if health is None:
+            return {"ok": True, "enabled": False}
+        doc = health.doc()
+        doc["ok"] = not doc.get("quarantined")
+        doc["enabled"] = True
+        return doc
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

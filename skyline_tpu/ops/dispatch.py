@@ -137,6 +137,35 @@ def chip_barrier_policy() -> str:
     return v if v in ("merge", "checkpoint", "off") else "merge"
 
 
+def chip_merge_deadline_ms() -> float:
+    """``SKYLINE_CHIP_MERGE_DEADLINE_MS``: per-chip budget for one level-1
+    tournament inside the sharded two-level merge. ``0`` (default)
+    disables the bound — the historical synchronous loop, where one sick
+    chip wedges the query. With a deadline the facade runs each chip's
+    merge on a watchdog thread: a chip that misses the budget (after the
+    ``SKYLINE_CHIP_MERGE_RETRIES``/``SKYLINE_CHIP_HEDGE_MS`` ladder) is
+    EXCLUDED from this answer, the surviving-chips skyline publishes
+    marked ``partial`` (RUNBOOK §2p), and ChipHealth quarantines the
+    offender. Read lazily per merge launch."""
+    from skyline_tpu.analysis.registry import env_float
+
+    return max(0.0, env_float("SKYLINE_CHIP_MERGE_DEADLINE_MS", 0.0))
+
+
+def chip_failover_enabled() -> bool:
+    """``SKYLINE_CHIP_FAILOVER`` gates online partition-group failover
+    (``distributed/sharded.py`` ``maybe_failover``): at merge-launch (and
+    worker idle ticks) a quarantined chip's partition group is re-owned
+    by a healthy chip — state carried over byte-faithfully, currency
+    checked against the chip's WAL window since the last common barrier —
+    and the slot heals, no stop-the-world restart. Default ON; set ``0``
+    to leave quarantined chips excluded until an operator intervenes
+    (answers stay degraded). Read lazily per launch."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_CHIP_FAILOVER", True)
+
+
 def flush_prefilter_enabled() -> bool:
     """``SKYLINE_FLUSH_PREFILTER`` gates the quantized grid prefilter ahead
     of the flush merge path (``stream/batched.py``): each partition keeps a

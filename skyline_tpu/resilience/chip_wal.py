@@ -103,6 +103,40 @@ class ChipWalPlane:
         for c, w in enumerate(self._writers):
             w.barrier(dict(rec, chip=c, chips=self.chips))
 
+    def failover_window(self, chip: int) -> dict:
+        """The chip-local replay window an online failover re-owns
+        (RUNBOOK §2p): ``chip``'s journal records SINCE the last barrier
+        common to all chips — exactly the chip-local segment whose
+        effects the new owner must carry, no stop-the-world, no other
+        chip's journal touched. Returns the common barrier seq, the
+        post-barrier record/row counts, and the chip's newest journaled
+        epoch digest (the currency the healed group is verified
+        against)."""
+        self._writers[chip].flush(force=True)
+        base = verify_chip_barriers(self.wal_dir, self.chips)
+        records = read_chip_records(self.wal_dir, self.chips)[chip]
+        seq = base["common_seq"]
+        tail: list[dict] = []
+        seen = seq is None  # no common barrier: the whole journal replays
+        for r in records:
+            if not seen:
+                if r.get("type") == "chip-barrier" and r.get("seq") == seq:
+                    seen = True
+                continue
+            tail.append(r)
+        flushes = [r for r in tail if r.get("type") == "flush"]
+        last_epoch = (
+            flushes[-1]["epoch"] if flushes
+            else (base["epoch"] if seq is not None else None)
+        )
+        return {
+            "common_seq": seq,
+            "records": len(tail),
+            "replay_flushes": len(flushes),
+            "replay_rows": sum(int(r.get("rows", 0)) for r in flushes),
+            "last_epoch": last_epoch,
+        }
+
     def close(self) -> None:
         for w in self._writers:
             w.close()

@@ -89,7 +89,19 @@ class Supervisor:
             try:
                 return self._run_incarnation(attempt)
             except (InjectedCrash, Exception) as e:
-                self.crashes.append(f"{type(e).__name__}: {e}")
+                crash = f"{type(e).__name__}: {e}"
+                # chip attribution (RUNBOOK §2p): an injected fault carries
+                # the kill point + chip it fired at; stamp them into the
+                # crash line so the flight dump says WHICH chip died, not
+                # just that something did
+                point = getattr(e, "point", None)
+                chip = getattr(e, "chip", None)
+                if point is not None:
+                    crash += f" [point={point}"
+                    if chip is not None:
+                        crash += f" chip={chip}"
+                    crash += "]"
+                self.crashes.append(crash)
                 self.restarts += 1
                 if self.telemetry is not None:
                     self.telemetry.inc("resilience.restarts")

@@ -39,6 +39,8 @@ operator observability; this one serves the skyline itself. Endpoints:
                   imbalance index + skew score, freshness watermark, last
                   EXPLAIN chip attribution (sharded workers; flat workers
                   report {"enabled": false}).
+  GET  /health    chip-health block (RUNBOOK §2p): per-chip score/status +
+                  quarantine state (flat workers report {"enabled": false}).
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -220,6 +222,15 @@ class SkylineServer:
         # the SLO engine samples shed/served counts from this plane's
         # admission controller (they live on it, not the hub)
         self.telemetry.slo.attach_admission(self.admission)
+        from skyline_tpu.analysis.registry import env_float
+
+        self._ready_timeout_s = env_float("SKYLINE_SERVE_READY_TIMEOUT_S", 10.0)
+        self._shutdown_timeout_s = env_float(
+            "SKYLINE_SERVE_SHUTDOWN_TIMEOUT_S", 10.0
+        )
+        self._header_timeout_s = env_float(
+            "SKYLINE_SERVE_HEADER_TIMEOUT_S", 10.0
+        )
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._startup_error: BaseException | None = None
@@ -229,7 +240,7 @@ class SkylineServer:
             target=self._run, args=(host, port, ready), daemon=True
         )
         self._thread.start()
-        ready.wait(timeout=10)
+        ready.wait(timeout=self._ready_timeout_s)
         if self._startup_error is not None:
             raise self._startup_error
 
@@ -256,7 +267,7 @@ class SkylineServer:
         if self._startup_error is not None:
             return
         self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=10)
+        self._thread.join(timeout=self._shutdown_timeout_s)
 
     # -- request plumbing --------------------------------------------------
 
@@ -264,7 +275,8 @@ class SkylineServer:
         try:
             try:
                 head = await asyncio.wait_for(
-                    reader.readuntil(b"\r\n\r\n"), timeout=10
+                    reader.readuntil(b"\r\n\r\n"),
+                    timeout=self._header_timeout_s,
                 )
             except (
                 asyncio.IncompleteReadError,
@@ -342,6 +354,8 @@ class SkylineServer:
             await self._audit(writer, params)
         elif path == "/fleet" and method == "GET":
             await self._fleet(writer)
+        elif path == "/health" and method == "GET":
+            await self._health(writer)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -569,6 +583,19 @@ class SkylineServer:
         except Exception:
             stats = {}
         await self._reply(writer, 200, fleet_doc(self.telemetry, stats))
+
+    async def _health(self, writer):
+        """The /health chip block (RUNBOOK §2p): per-chip health scores +
+        quarantine state. Flat workers report {"enabled": false} so probes
+        can distinguish "plane off" from "all healthy"."""
+        health = getattr(self.telemetry, "health", None)
+        if health is None:
+            await self._reply(writer, 200, {"ok": True, "enabled": False})
+            return
+        doc = health.doc()
+        doc["ok"] = not doc.get("quarantined")
+        doc["enabled"] = True
+        await self._reply(writer, 200, doc)
 
     async def _deltas(self, writer, params):
         ok, retry = self.admission.admit_read()

@@ -637,13 +637,25 @@ class SkylineEngine:
             partial_missing=partial_missing,
         )
 
-    def _publish_snapshot(self, points, q: _QueryState, source_key=None) -> None:
+    def _publish_snapshot(
+        self, points, q: _QueryState, source_key=None, degraded=None
+    ) -> None:
         """Publish a completed global skyline, stamped with the query's
         trace id and wrapped in a "publish" span when telemetry is on.
         ``source_key``: opaque identity of the engine state the points came
         from (the partition-epoch key) — the store dedupes repeat publishes
-        of an unchanged state instead of minting a new version."""
+        of an unchanged state instead of minting a new version.
+        ``degraded``: the sharded facade's partial marker — the snapshot
+        carries honest incompleteness fields (``partial``,
+        ``excluded_chips``, ``completeness_bound``) all the way to
+        ``/skyline`` (RUNBOOK §2p). Callers pass ``source_key=None`` with
+        it: a degraded snapshot must never dedupe against — or be served
+        in place of — a full snapshot of the same engine state."""
         meta = {"query_id": q.qid, "source_key": source_key}
+        if degraded is not None:
+            meta["partial"] = True
+            meta["excluded_chips"] = degraded["excluded_chips"]
+            meta["completeness_bound"] = degraded["completeness_bound"]
         if q.trace_id is not None:
             meta["trace_id"] = q.trace_id
         if self.freshness is not None:
@@ -680,6 +692,7 @@ class SkylineEngine:
         latency_ms: float,
         points=None,
         partial_missing=None,
+        degraded=None,
     ) -> None:
         result = {
             "query_id": q.qid,
@@ -695,6 +708,13 @@ class SkylineEngine:
         if partial_missing is not None:
             result["partial"] = True
             result["missing_partitions"] = partial_missing
+        if degraded is not None:
+            # chip-level degradation (RUNBOOK §2p): the answer is a sound
+            # SUBSET of the truth — surviving chips' union — marked with
+            # who is missing and how much mass the bound guarantees
+            result["partial"] = True
+            result["excluded_chips"] = degraded["excluded_chips"]
+            result["completeness_bound"] = degraded["completeness_bound"]
         if points is not None:
             result["skyline_points"] = (
                 points.tolist() if hasattr(points, "tolist") else points
@@ -705,6 +725,12 @@ class SkylineEngine:
                 # after the reference's fields, so parity consumers are
                 # unaffected (bridge/wire.py)
                 result["trace_id"] = q.trace_id
+            # SLO denominator/numerator pair: every emitted answer counts,
+            # chip-degraded ones additionally burn the degraded budget
+            # (skyline_degraded_answers_total, telemetry/slo.py)
+            self.telemetry.inc("queries.answered")
+            if degraded is not None:
+                self.telemetry.inc("degraded_answers")
             self.telemetry.histogram("query_latency_ms").observe(latency_ms)
             if q.span_t0_ns:
                 self.telemetry.spans.record(
@@ -712,7 +738,7 @@ class SkylineEngine:
                     trace_id=q.trace_id,
                     args={"query_id": q.qid, "skyline_size": skyline_size},
                 )
-        if self.workload is not None and partial_missing is None:
+        if self.workload is not None and partial_missing is None and degraded is None:
             # one trajectory point per complete answer (partials would
             # poison the dominance-rate series with truncated skylines)
             self.workload.note_query(skyline_size, self.records_in)
@@ -728,6 +754,7 @@ class SkylineEngine:
         if (
             self.auditor is not None
             and partial_missing is None
+            and degraded is None
             and self.snapshots is not None
         ):
             # shadow-verify AFTER the answer is out the door (plan already
@@ -900,12 +927,22 @@ class SkylineEngine:
     ) -> None:
         """Shared tail of the device answer paths (blocking + overlapped):
         snapshot publish, timing decomposition, result emission."""
+        # chip-level degradation marker from the sharded facade's harvest
+        # (None on flat engines and on full sharded answers)
+        degraded = getattr(self.pset, "last_partial", None)
         if self.snapshots is not None:
             # the epoch key identifies the flushed state the merge saw, so
             # repeated triggers over unchanged state dedupe in the store
             # (the host _finalize path publishes un-keyed: its unions mix
-            # per-partition arrival times, so no single key describes them)
-            self._publish_snapshot(pts, q, source_key=source_key)
+            # per-partition arrival times, so no single key describes
+            # them). A DEGRADED answer publishes un-keyed too: it must
+            # never dedupe against — nor be deduped by — a full snapshot
+            # of the same epoch.
+            if degraded is not None:
+                self._publish_snapshot(pts, q, source_key=None,
+                                       degraded=degraded)
+            else:
+                self._publish_snapshot(pts, q, source_key=source_key)
 
         starts = [s for s in self.pset.start_time_ms if s is not None]
         map_finish = now_ms + flush_wall_ms
@@ -923,6 +960,7 @@ class SkylineEngine:
             total_ms=now - job_start,
             latency_ms=now - q.dispatch_ms,
             points=pts if self.config.emit_skyline_points else None,
+            degraded=degraded,
         )
 
     # -- failure detection -------------------------------------------------

@@ -74,6 +74,10 @@ class Telemetry:
         # block, and the skyline_chip_*{chip=...} metric families
         self.fleet = None
         self.workload = None
+        # chip-health plane (RUNBOOK §2p): attached by the sharded engine
+        # (None on flat workers); serves the /health chip block and the
+        # quarantine state on /fleet
+        self.health = None
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -129,6 +133,10 @@ class Telemetry:
         # always expose the drop counter (zero included) so dashboards can
         # alert on the first overwrite
         counters["telemetry.spans_dropped"] = self.spans.dropped
+        # honest-degradation signal (RUNBOOK §2p): always exposed, zero
+        # included — a scrape must distinguish "no degraded answers" from
+        # "the series doesn't exist", and the mesh smoke asserts presence
+        counters.setdefault("degraded_answers", 0)
         # persistent-compile-cache effectiveness (utils/compile_cache.py):
         # a rising miss count on a warm cache is a retrace regression
         # visible without the jaxpr audit

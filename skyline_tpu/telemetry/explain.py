@@ -228,6 +228,13 @@ def format_plan(doc: dict) -> str:
             f" alive={ch.get('alive')} survivors={ch.get('survivors')}"
             f" cross_levels={ch.get('levels')}"
         )
+        dg = ch.get("degraded")
+        if dg is not None:
+            lines.append(
+                f"    DEGRADED: excluded chips {dg.get('excluded_chips')}"
+                f" completeness>={dg.get('completeness_bound')}"
+                f" reasons={dg.get('reasons')}"
+            )
         for pr in ch.get("pruned") or []:
             lines.append(
                 f"    chip {pr['chip']} pruned by witness of chip "

@@ -215,6 +215,10 @@ def fleet_doc(telemetry, stats: dict | None) -> dict:
     doc: dict = {"enabled": fleet is not None}
     if fleet is not None:
         doc.update(fleet.doc())
+    # chip-health join (RUNBOOK §2p): quarantine state rides /fleet so one
+    # scrape answers "which chip is sick AND how loaded is the rest"
+    health = getattr(telemetry, "health", None) if telemetry is not None else None
+    doc["health"] = health.doc() if health is not None else None
     fr = (stats or {}).get("freshness")
     doc["freshness_wm_ms"] = fr.get("published_wm_ms") if isinstance(fr, dict) else None
     plan = telemetry.explain.latest() if telemetry is not None else None

@@ -1,6 +1,6 @@
 """Declarative SLOs with multi-window burn-rate evaluation (``GET /slo``).
 
-Five objectives, each a row in a declarative table (targets are knobs, see
+Six objectives, each a row in a declarative table (targets are knobs, see
 RUNBOOK §2j):
 
 - ``read_p99``       — 99% of /skyline reads complete under
@@ -15,6 +15,10 @@ RUNBOOK §2j):
                        audited snapshots diverge from the host oracle
                        (RUNBOOK §2l; the budget exists only so burn math
                        is well-formed — any divergence should page).
+- ``degraded_answers`` — at most ``SKYLINE_SLO_DEGRADED_ANSWERS`` of
+                       answered queries publish chip-degraded (marked
+                       ``partial``, RUNBOOK §2p) — the availability the
+                       failover layer is accountable for.
 
 Evaluation is the standard SRE multi-window scheme: each ``evaluate()``
 samples the cumulative counters, appends them to a bounded ring, and diffs
@@ -80,6 +84,10 @@ class SloEngine:
                 "fraction",
                 env_float("SKYLINE_SLO_AUDIT_DIVERGENCE", 0.0001),
             ),
+            "degraded_answers": (
+                "fraction",
+                env_float("SKYLINE_SLO_DEGRADED_ANSWERS", 0.01),
+            ),
         }
         self._admission = None  # serve-plane counters (reads_served/shed)
         self._lock = threading.Lock()
@@ -116,6 +124,9 @@ class SloEngine:
         checks = int(tel.counters.get("audit.checks"))
         div = int(tel.counters.get("audit.divergence"))
         out["audit_divergence"] = (checks, div)
+        answered = int(tel.counters.get("queries.answered"))
+        degraded = int(tel.counters.get("degraded_answers"))
+        out["degraded_answers"] = (answered, degraded)
         return out
 
     def _window(self, samples, now_s: float, window_s: float, name: str):

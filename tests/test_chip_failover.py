@@ -1,0 +1,514 @@
+"""Chip-level fault tolerance (RUNBOOK §2p): deadline-bounded level-1
+merges, honest degraded answers, health-scored quarantine, and online
+partition-group failover.
+
+The acceptance grid injects a chip fault (crash / slow / hang, scoped to
+one chip) into the sharded two-level merge and asserts three things:
+
+1. the degraded answer is SOUND — byte-identical to the host oracle's
+   skyline of the surviving chips' records, with the excluded chip and a
+   completeness bound honestly reported;
+2. the faulty chip quarantines and ``maybe_failover`` re-owns its
+   partition group onto a healthy chip;
+3. the first post-heal answer is byte-identical to an uninterrupted
+   single-device run — failover loses nothing.
+
+The engine-level tests thread the ``partial`` marker through the emitted
+result and published snapshot meta, and pin the auditor's discipline on
+partial snapshots: a marked-degraded subset must SKIP, never count as
+divergence.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from skyline_tpu.audit import canonical_rows
+from skyline_tpu.audit.oracle import oracle_fn
+from skyline_tpu.distributed import ShardedEngine, ShardedPartitionSet
+from skyline_tpu.resilience.faults import (
+    FaultClause,
+    FaultPlan,
+    InjectedCrash,
+    clear,
+    install_plan,
+)
+from skyline_tpu.resilience.health import ChipHealth
+from skyline_tpu.stream import EngineConfig
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.telemetry import Telemetry
+
+from conftest import assert_same_merge, gen_points, merge_state
+
+P = 4  # divisible by every chip count in the grid
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    clear()
+    yield
+    clear()
+
+
+def _join_abandoned(chip: int, timeout: float = 15.0) -> None:
+    """Wait out watchdog attempts the deadline abandoned (a slow/hang
+    clause leaves its thread finishing late; it must drain before the
+    test touches the old group again)."""
+    for t in threading.enumerate():
+        if t.name.startswith(f"chip{chip}-merge"):
+            t.join(timeout=timeout)
+
+
+def _feed(ps, x: np.ndarray) -> None:
+    pids = np.arange(x.shape[0]) % P
+    for p in range(P):
+        rows = np.ascontiguousarray(x[pids == p])
+        if rows.shape[0]:
+            ps.add_batch(p, rows, max_id=x.shape[0], now_ms=0.0)
+    ps.flush_all()
+
+
+# --------------------------------------------------------------------------
+# fault-verb parsing: slow / hang actions, #chip scoping
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_parses_latency_verbs_and_chip_scope():
+    plan = FaultPlan.parse(
+        "slow@sharded.chip_merge#2:1,hang@sharded.chip_merge:3"
+    )
+    slow, hang = plan.clauses
+    assert slow.action == "slow" and slow.base == "sharded.chip_merge"
+    assert slow.chip == 2 and slow.nth == 1
+    assert hang.action == "hang" and hang.chip is None and hang.nth == 3
+
+
+@pytest.mark.parametrize("spec", [
+    "slow@sharded.chip_merge#x:1",   # non-integer scope
+    "slow@sharded.chip_merge#-1:1",  # negative scope
+    "wedge@sharded.chip_merge:1",    # unknown action
+    "slow@no.such.point:1",          # unknown base point
+])
+def test_fault_plan_rejects_bad_clauses(spec):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(spec)
+
+
+def test_scoped_clause_counts_only_its_chips_hits():
+    plan = FaultPlan.parse("corrupt@sharded.chip_merge#1:2")
+    install_plan(plan)
+    # chip 1's FIRST hit interleaved with chip 0 traffic must not fire;
+    # its second hit must, regardless of the global hit count
+    assert not plan.hit("sharded.chip_merge", chip=0)
+    assert not plan.hit("sharded.chip_merge", chip=1)
+    assert not plan.hit("sharded.chip_merge", chip=0)
+    assert plan.hit("sharded.chip_merge", chip=1)
+    assert plan.last_fired["chip"] == 1 and plan.last_fired["hit"] == 2
+
+
+def test_chip_scoped_crash_carries_attribution():
+    plan = FaultPlan.parse("crash@sharded.chip_merge#0:1")
+    install_plan(plan)
+    with pytest.raises(InjectedCrash) as ei:
+        plan.hit("sharded.chip_merge", chip=0)
+    assert ei.value.chip_scoped and ei.value.chip == 0
+    assert ei.value.point == "sharded.chip_merge"
+    # an UNSCOPED clause still models process death
+    clear()
+    install_plan(FaultPlan.parse("crash@sharded.chip_merge:1"))
+    with pytest.raises(InjectedCrash) as ei:
+        from skyline_tpu.resilience.faults import fault_point
+
+        fault_point("sharded.chip_merge", chip=1)
+    assert not ei.value.chip_scoped
+
+
+def test_hang_clause_released_by_clear():
+    install_plan(FaultPlan.parse("hang@sharded.chip_merge#0:1"))
+    released = threading.Event()
+
+    def run():
+        from skyline_tpu.resilience.faults import fault_point
+
+        fault_point("sharded.chip_merge", chip=0)
+        released.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert not released.wait(0.2), "hang clause returned immediately"
+    clear()
+    assert released.wait(5.0), "clear() did not release the hung thread"
+    t.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# the acceptance grid: kind x d x chips x fault action at the pset level
+# --------------------------------------------------------------------------
+
+_KIND_OF = {2: "uniform", 4: "correlated", 8: "anti"}
+
+
+@pytest.mark.parametrize("action", ["crash", "slow", "hang"])
+@pytest.mark.parametrize("chips", [2, 4])
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_failover_grid(rng, monkeypatch, d, chips, action):
+    kind = _KIND_OF[d]
+    x = gen_points(rng, 400, d, kind)
+    pids = np.arange(x.shape[0]) % P
+    G = P // chips
+
+    single = PartitionSet(P, d, buffer_size=64)
+    _feed(single, x)
+    base = merge_state(single)
+
+    sp = ShardedPartitionSet(P, d, 64, chips=chips)
+    health = ChipHealth(chips)
+    sp.attach_health(health)
+    _feed(sp, x)
+    # warm merge with the deadline OFF: the one-off compile wall must not
+    # count against any chip
+    monkeypatch.delenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", raising=False)
+    assert_same_merge(base, merge_state(sp), ctx="pre-fault")
+
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "500")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_RETRIES", "0")
+    monkeypatch.setenv("SKYLINE_FAULT_SLOW_MS", "2000")
+    install_plan(FaultPlan.parse(f"{action}@sharded.chip_merge#1:1"))
+    sp._gm_cache = None  # same epoch: force the level-1 pass to rerun
+    counts, surv, g, pts = sp.global_merge_stats(emit_points=True)
+    clear()
+    _join_abandoned(1)
+
+    # honest degradation: excluded chip + completeness bound reported
+    partial = sp.last_partial
+    assert partial is not None, f"{action} fault did not degrade the merge"
+    assert partial["excluded_chips"] == [1]
+    assert len(partial["reasons"]) == 1
+    assert 0.0 < partial["completeness_bound"] < 1.0
+    assert partial["excluded_records"] == int(
+        (pids // G == 1).sum()
+    )
+    assert sp.degraded_merges == 1
+    # soundness: the degraded answer IS the skyline of the surviving
+    # chips' records — no invented rows, nothing silently dropped
+    surv_rows = x[pids // G != 1]
+    oracle = np.asarray(oracle_fn()(surv_rows), dtype=np.float32)
+    ctx = f"kind={kind} d={d} chips={chips} action={action}"
+    assert (
+        canonical_rows(pts).tobytes() == canonical_rows(oracle).tobytes()
+    ), f"degraded answer is not the surviving-chip skyline ({ctx})"
+
+    # quarantine + online failover re-owns the group from the survivors
+    assert health.quarantined() == [1]
+    monkeypatch.delenv("SKYLINE_CHIP_MERGE_DEADLINE_MS")
+    healed = sp.maybe_failover()
+    assert healed == [1]
+    assert health.quarantined() == []
+    lf = sp.last_failover
+    assert lf is not None and lf["chip"] == 1 and lf["owner"] != 1
+    assert str(sp._devices[1]) == str(sp._devices[lf["owner"]])
+
+    # first post-heal answer: byte-identical to the uninterrupted run
+    post = merge_state(sp)
+    assert sp.last_partial is None
+    assert_same_merge(base, post, ctx=f"post-heal {ctx}")
+
+
+def test_unscoped_crash_in_bounded_merge_is_process_death(rng, monkeypatch):
+    """An UNSCOPED crash clause must escape the watchdog — it models the
+    process dying, and absorbing it as a chip fault would hide a real
+    crash behind a degraded answer."""
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    _feed(sp, x)
+    merge_state(sp)  # warm
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "500")
+    install_plan(FaultPlan.parse("crash@sharded.chip_merge:1"))
+    sp._gm_cache = None
+    with pytest.raises(InjectedCrash):
+        sp.global_merge_stats()
+    assert sp.degraded_merges == 0
+
+
+def test_failover_window_reports_chip_tail(rng, tmp_path, monkeypatch):
+    """The chip WAL's failover accounting: records journaled by the dead
+    chip past the last common barrier, plus its newest epoch digest."""
+    from skyline_tpu.resilience.chip_wal import ChipWalPlane
+
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    plane = ChipWalPlane(str(tmp_path), chips=2, fsync="off")
+    sp.attach_chip_wal(plane)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    _feed(sp, x)
+    merge_state(sp)  # writes a seq-1 barrier on both journals
+    # chip 1 journals a flush AFTER the common barrier: that is its
+    # replay window
+    plane.note_flush(1, 7, "deadbeef")
+    win = plane.failover_window(1)
+    assert win["common_seq"] == 1
+    assert win["records"] == 1 and win["replay_flushes"] == 1
+    assert win["replay_rows"] == 7
+    assert win["last_epoch"] == "deadbeef"
+    # failover stamps the window into last_failover
+    health.quarantine(1, "test")
+    assert sp.maybe_failover() == [1]
+    assert sp.last_failover["wal_window"]["replay_rows"] == 7
+    plane.close()
+
+
+def test_failover_stalls_without_healthy_owner(rng):
+    d = 2
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    health.quarantine(0, "test")
+    health.quarantine(1, "test")
+    assert sp.maybe_failover() == []
+    assert sp.failovers == 0
+
+
+def test_failover_disabled_by_knob(rng, monkeypatch):
+    monkeypatch.setenv("SKYLINE_CHIP_FAILOVER", "0")
+    sp = ShardedPartitionSet(P, 2, 64, chips=2)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    health.quarantine(1, "test")
+    assert sp.maybe_failover() == []
+    assert health.quarantined() == [1]
+
+
+# --------------------------------------------------------------------------
+# ChipHealth scoring unit behavior
+# --------------------------------------------------------------------------
+
+
+def test_health_scores_quarantine_and_heal(monkeypatch):
+    monkeypatch.setenv("SKYLINE_CHIP_FAIL_THRESHOLD", "2")
+    h = ChipHealth(2)
+    h.note_merge_error(1, "boom")
+    assert h.quarantined() == []  # one failure under the threshold
+    h.note_merge_error(1, "boom again")
+    assert h.quarantined() == [1]
+    doc = h.doc()
+    rec = doc["per_chip"][1]
+    assert rec["status"] == "quarantined"
+    assert rec["consecutive_failures"] == 2
+    assert "boom" in rec["quarantine_reason"]
+    h.heal(1)
+    assert h.quarantined() == []
+    assert h.doc()["per_chip"][1]["score"] == 1.0
+
+
+def test_health_clean_merges_recover_score():
+    h = ChipHealth(2)
+    h.note_merge_error(0, "hiccup")
+    h.heal(0)
+    s0 = h.doc()["per_chip"][0]["score"]
+    for _ in range(4):
+        h.note_merge_ok(0, 5.0)
+        h.note_merge_ok(1, 5.0)
+    assert h.doc()["per_chip"][0]["score"] >= s0
+
+
+def test_health_straggler_warmup_gate(monkeypatch):
+    """Cold-compile walls (chip 0 pays the one-off compile, peers reuse)
+    must not score as straggling — the gate holds until a chip has a few
+    clean merges behind it."""
+    monkeypatch.setenv("SKYLINE_CHIP_STRAGGLER_FACTOR", "4.0")
+    h = ChipHealth(2)
+    h.note_merge_ok(1, 5.0)
+    h.note_merge_ok(0, 500.0)  # compile wall, merges_ok == 1: gated
+    assert h.doc()["per_chip"][0]["stragglers"] == 0
+    for _ in range(3):
+        h.note_merge_ok(0, 5.0)
+        h.note_merge_ok(1, 5.0)
+    h.note_merge_ok(0, 500.0)  # past warmup: scores as a straggle
+    assert h.doc()["per_chip"][0]["stragglers"] == 1
+
+
+def test_health_tick_relative_staleness(monkeypatch):
+    monkeypatch.setenv("SKYLINE_CHIP_HEARTBEAT_MS", "1000")
+    h = ChipHealth(2)
+    # whole fleet idle: nobody quarantines
+    for r in h._rec:
+        r.heartbeat_s -= 10.0
+    h.tick()
+    assert h.quarantined() == []
+    # one chip stale while a peer is fresh: quarantine on age
+    h.note_heartbeat(0)
+    h.tick()
+    assert h.quarantined() == [1]
+
+
+# --------------------------------------------------------------------------
+# engine level: partial marker on the emitted result + snapshot meta,
+# audit skips-not-diverges, degraded counters
+# --------------------------------------------------------------------------
+
+
+def _drive(engine, x, qid, lo, hi):
+    ids = np.arange(lo, hi, dtype=np.int64)
+    engine.process_records(ids, x[lo:hi])
+    engine.process_trigger(f"{qid},0")
+    out = []
+    for _ in range(200):
+        out.extend(engine.poll_results())
+        if out:
+            return out
+    raise AssertionError("engine produced no result")
+
+
+def test_engine_degraded_answer_marked_and_audited_honestly(
+    rng, monkeypatch
+):
+    monkeypatch.setenv("SKYLINE_AUDIT_SAMPLE", "1.0")
+    d = 4
+    cfg = EngineConfig(parallelism=P, dims=d, buffer_size=64,
+                       domain_max=1.0, emit_skyline_points=True)
+    telem = Telemetry()
+    eng = ShardedEngine(cfg, chips=2, telemetry=telem)
+    from skyline_tpu.serve import SnapshotStore
+
+    eng.attach_snapshots(SnapshotStore(history=8))
+    x = gen_points(rng, 600, d, "uniform")
+
+    # query 1: healthy (warm; compile walls land here)
+    r1 = _drive(eng, x, 0, 0, 300)[-1]
+    assert "partial" not in r1
+    checks_before = int(telem.counters.get("audit.checks"))
+    assert checks_before >= 1
+
+    # query 2: chip 1 hangs past the deadline -> honest degraded answer
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "500")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_RETRIES", "0")
+    install_plan(FaultPlan.parse("hang@sharded.chip_merge#1:1"))
+    r2 = _drive(eng, x, 1, 300, 600)[-1]
+    clear()
+    _join_abandoned(1)
+    assert r2["partial"] is True
+    assert r2["excluded_chips"] == [1]
+    assert 0.0 < r2["completeness_bound"] < 1.0
+    snap = eng.snapshots.latest()
+    assert snap.meta.get("partial") is True
+    assert snap.meta.get("excluded_chips") == [1]
+    # the auditor must SKIP the marked-degraded snapshot, not call the
+    # honest subset a divergence: the emit path never audits a degraded
+    # answer, and a canary landing on the partial snapshot skips
+    assert int(telem.counters.get("audit.checks")) == checks_before
+    assert eng.auditor.check() is None
+    assert int(telem.counters.get("audit.checks")) == checks_before
+    assert int(telem.counters.get("audit.skips")) >= 1
+    assert int(telem.counters.get("audit.divergence")) == 0
+    skips = [
+        e for e in telem.flight.snapshot()
+        if e["kind"] == "audit.skip"
+        and e.get("reason") == "partial_snapshot"
+    ]
+    assert skips, "auditor did not record the partial-snapshot skip"
+    # honest-degradation counters: the SLO pair + stats surfaces
+    assert int(telem.counters.get("degraded_answers")) == 1
+    assert int(telem.counters.get("queries.answered")) == 2
+    cum = telem.slo._cumulative()["degraded_answers"]
+    assert cum == (2, 1)
+    assert "skyline_degraded_answers_total 1" in telem.render_prometheus()
+    stats = eng.stats()
+    assert stats["sharded"]["degraded_merges"] == 1
+    assert stats["sharded"]["health"]["quarantined"] == [1]
+    # EXPLAIN carries the degraded attribution
+    from skyline_tpu.telemetry.explain import format_plan
+
+    plan = telem.explain.latest()
+    assert plan["chips"]["degraded"]["excluded_chips"] == [1]
+    assert plan["merge"]["partial"] is True
+    rendered = format_plan(plan)
+    assert "DEGRADED: excluded chips [1]" in rendered
+
+    # query 3: failover heals chip 1, the answer is full again and
+    # byte-identical to an uninterrupted single-device run
+    monkeypatch.delenv("SKYLINE_CHIP_MERGE_DEADLINE_MS")
+    from skyline_tpu.stream import SkylineEngine
+
+    base_eng = SkylineEngine(cfg, telemetry=Telemetry())
+    _drive(base_eng, x, 0, 0, 300)
+    base = _drive(base_eng, x, 1, 300, 600)[-1]
+    r3 = _drive(eng, x, 2, 600, 600)[-1]  # no new rows, force remerge
+    assert "partial" not in r3
+    assert eng.pset.failovers == 1
+    assert eng.health.quarantined() == []
+    np.testing.assert_array_equal(
+        np.asarray(r3["skyline_points"], dtype=np.float32),
+        np.asarray(base["skyline_points"], dtype=np.float32),
+    )
+    assert int(telem.counters.get("health.quarantines")) == 1
+    assert int(telem.counters.get("health.heals")) == 1
+
+
+def test_degraded_publish_never_dedupes_against_full_snapshot(
+    rng, monkeypatch
+):
+    """A degraded publish carries ``source_key=None``: even at the same
+    partition epoch it must land as a NEW snapshot version, never dedupe
+    against (or be deduped by) a full answer of the same state."""
+    d = 2
+    cfg = EngineConfig(parallelism=P, dims=d, buffer_size=64,
+                       domain_max=1.0, emit_skyline_points=True)
+    telem = Telemetry()
+    eng = ShardedEngine(cfg, chips=2, telemetry=telem)
+    from skyline_tpu.serve import SnapshotStore
+
+    eng.attach_snapshots(SnapshotStore(history=8))
+    x = gen_points(rng, 300, d, "uniform")
+    _drive(eng, x, 0, 0, 300)
+    v1 = eng.snapshots.latest().version
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "500")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_RETRIES", "0")
+    monkeypatch.setenv("SKYLINE_FAULT_SLOW_MS", "2000")
+    install_plan(FaultPlan.parse("slow@sharded.chip_merge#1:1"))
+    eng.pset._gm_cache = None
+    _drive(eng, x, 1, 300, 300)  # same epoch, degraded remerge
+    clear()
+    _join_abandoned(1)
+    snap = eng.snapshots.latest()
+    assert snap.version > v1
+    assert snap.meta.get("partial") is True
+
+
+def test_serve_health_endpoint_reports_quarantine(rng):
+    """/health on the serving plane: chip block when a ChipHealth hub is
+    attached, probe-friendly {"enabled": false} otherwise."""
+    import json as _json
+    import urllib.request
+
+    from skyline_tpu.serve import SnapshotStore
+    from skyline_tpu.serve.server import SkylineServer
+
+    telem = Telemetry()
+    telem.health = ChipHealth(2, telemetry=telem)
+    telem.health.quarantine(1, "drill")
+    srv = SkylineServer(SnapshotStore(history=2), telemetry=telem)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/health", timeout=5
+        ) as resp:
+            doc = _json.loads(resp.read())
+        assert doc["enabled"] is True and doc["ok"] is False
+        assert doc["quarantined"] == [1]
+        assert doc["per_chip"][1]["status"] == "quarantined"
+    finally:
+        srv.close()
+    bare = SkylineServer(SnapshotStore(history=2), telemetry=Telemetry())
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{bare.port}/health", timeout=5
+        ) as resp:
+            doc = _json.loads(resp.read())
+        assert doc == {"ok": True, "enabled": False}
+    finally:
+        bare.close()

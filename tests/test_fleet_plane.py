@@ -168,8 +168,8 @@ def test_fleet_doc_join_and_http_surface(rng):
 
 def test_fleet_doc_reports_disabled_on_flat_worker():
     doc = fleet_doc(Telemetry(), {})
-    assert doc == {"enabled": False, "freshness_wm_ms": None,
-                   "last_query": None}
+    assert doc == {"enabled": False, "health": None,
+                   "freshness_wm_ms": None, "last_query": None}
 
 
 def test_serve_surface_fleet_route(rng):
