@@ -133,6 +133,11 @@ KNOBS: tuple[Knob, ...] = (
        "online partition-group failover: a quarantined chip's group is "
        "re-owned by a healthy chip at the next merge launch",
        "engine/sharded", runbook="§2p"),
+    _k("SKYLINE_CHIP_FAILOVER_LOCK_MS", "float", 5000.0,
+       "bounded wait for a chip's merge lock before failover captures "
+       "its group state (an in-flight merge attempt must drain first; "
+       "past the bound failover defers to the next tick)",
+       "engine/sharded", runbook="§2p"),
     _k("SKYLINE_QUERY_OVERLAP", "bool", True,
        "overlapped query sync: launch the global merge at trigger time, "
        "harvest at emission", "engine", runbook="§2f"),

@@ -125,9 +125,10 @@ class Auditor:
         tel = self.telemetry
         trace_id = snap.meta.get("trace_id")
         if snap.meta.get("partial"):
-            # a chip-degraded snapshot (RUNBOOK §2p) is an HONEST subset —
-            # by construction it differs from the full oracle, so checking
-            # it would count marked degradation as a lying answer
+            # a chip-degraded snapshot (RUNBOOK §2p) is honestly marked —
+            # it is the surviving chips' exact skyline, which by
+            # construction differs from the full oracle, so checking it
+            # would count marked degradation as a lying answer
             tel.inc("audit.skips")
             tel.flight.note(
                 "audit.skip", reason="partial_snapshot",

@@ -52,6 +52,7 @@ from skyline_tpu.ops.dispatch import (
     chip_failover_enabled,
     chip_merge_deadline_ms,
     chip_prune_enabled,
+    failover_lock_ms,
     fleet_enabled,
     merge_cache_enabled,
 )
@@ -216,8 +217,9 @@ class ShardedPartitionSet:
         # quarantine; the deadline-bounded level 1 runs each chip's merge
         # on a watchdog thread serialized by that chip's lock (a
         # PartitionSet is not thread-safe; an abandoned attempt must
-        # never interleave with a retry or a later merge on the same
-        # group)
+        # never interleave with a retry, a later merge, ingest, flush,
+        # checkpoint capture, or failover on the same group — all of
+        # which take the chip lock too)
         self._health = None
         self._chip_locks = [threading.Lock() for _ in range(chips)]
         self.degraded_merges = 0
@@ -340,7 +342,11 @@ class ShardedPartitionSet:
         c, lp = self._loc(p)
         if self._fleet is not None:
             self._fleet.note_ingest(c, n)
-        self._chips[c].add_batch(lp, values, max_id, now_ms)
+        # a deadline-abandoned merge attempt may still be running inside
+        # this chip's lock (see _bounded_level1); a PartitionSet is not
+        # thread-safe, so ingest serializes behind it
+        with self._chip_locks[c]:
+            self._chips[c].add_batch(lp, values, max_id, now_ms)
 
     def maybe_flush(self) -> bool:
         """The single-device flush-cadence decision verbatim, over the
@@ -362,16 +368,24 @@ class ShardedPartitionSet:
 
     def flush_all(self, tighten: bool = True) -> None:
         for c, chip in enumerate(self._chips):
-            rows = chip.pending_rows_total
-            t0 = time.perf_counter_ns()
-            with self._dev(c):
-                chip.flush_all(tighten)
+            # the chip lock serializes the flush against any
+            # deadline-abandoned merge attempt still in flight on this
+            # group (_bounded_level1); uncontended on a healthy fleet
+            with self._chip_locks[c]:
+                rows = chip.pending_rows_total
+                t0 = time.perf_counter_ns()
+                with self._dev(c):
+                    chip.flush_all(tighten)
             if self._fleet is not None and rows:
                 self._fleet.note_flush(
                     c, rows, (time.perf_counter_ns() - t0) / 1e6
                 )
             if self._chip_wal is not None and rows:
                 self._chip_wal.note_flush(c, rows, epoch_hex(chip.epoch_key))
+            if self._health is not None and rows:
+                # a completed flush proves the chip alive between merges:
+                # the liveness feed behind ChipHealth's staleness tick
+                self._health.note_heartbeat(c)
         self._pending_rows[:] = 0
 
     def flush_cascade_stats(self) -> dict:
@@ -401,10 +415,14 @@ class ShardedPartitionSet:
         With ``SKYLINE_CHIP_MERGE_DEADLINE_MS`` set, each chip's level-1
         merge is deadline-bounded (watchdog thread + retry/hedge ladder,
         see ``_bounded_level1``); a chip that exhausts its budget is
-        excluded and the handle carries a ``partial`` marker — the
-        surviving-chips skyline is a sound SUBSET of the true answer
-        (the global skyline decomposes over chip-local skylines), and
-        its missing mass is bounded by the excluded chips' record share
+        excluded and the handle carries a ``partial`` marker. The
+        degraded answer is the EXACT skyline of the surviving chips'
+        records — NOT a subset of the true global skyline: a surviving
+        point dominated only by excluded-chip data legitimately
+        appears. What it does guarantee: every true-skyline point that
+        lives on a surviving chip is present (the global skyline
+        decomposes over chip-local skylines), and the missing record
+        mass is bounded by the excluded chips' record share
         (RUNBOOK §2p)."""
         # heal before measuring: a quarantined chip's group is re-owned by
         # a healthy chip NOW, so this merge — and every later one — runs
@@ -458,13 +476,19 @@ class ShardedPartitionSet:
         for c, chip in enumerate(self._chips):
             t0 = time.perf_counter_ns()
             if bounded:
-                r = self._bounded_level1(
+                br = self._bounded_level1(
                     c, chip, want_prune, deadline_ms, failed
                 )
+                # the winning attempt's own wall (fault latency + merge,
+                # no backoff sleeps / hedge waits / failed attempts) —
+                # anything else would pollute the peer-median straggler
+                # signal with scheduler overhead
+                r, t0, t1 = br if br is not None else (None, t0, t0)
             else:
                 fault_point("sharded.chip_merge", chip=c)
-                r = self._level1_chip(c, chip, want_prune)
-            t1 = time.perf_counter_ns()
+                with self._chip_locks[c]:
+                    r = self._level1_chip(c, chip, want_prune)
+                t1 = time.perf_counter_ns()
             if r is None:
                 # excluded this merge: the group contributes nothing and
                 # the answer publishes marked partial (RUNBOOK §2p)
@@ -502,8 +526,9 @@ class ShardedPartitionSet:
                 "reasons": [f["reason"] for f in failed],
                 "excluded_records": lost,
                 # record-mass bound from the facade ledger: the surviving
-                # answer is a subset of the truth covering at least this
-                # fraction of every record ingested so far
+                # chips' exact skyline drew on at least this fraction of
+                # every record ingested so far (NOT a subset of the full
+                # skyline — see global_merge_launch)
                 "completeness_bound": (
                     round((total - lost) / total, 6) if total else 1.0
                 ),
@@ -660,7 +685,11 @@ class ShardedPartitionSet:
         (``SKYLINE_CHIP_MERGE_RETRIES`` extra attempts under exponential
         ``SKYLINE_CHIP_MERGE_BACKOFF_MS``), and optional straggler
         hedging (``SKYLINE_CHIP_HEDGE_MS`` > 0 races a second attempt;
-        first result wins). Returns the level-1 tuple, or ``None`` once
+        first result wins). Returns ``(level1_tuple, t0_ns, t1_ns)``
+        with the WINNING attempt's own perf-counter interval (fault
+        latency + merge wall, but no backoff sleeps, hedge waits, or
+        failed-attempt time — the health/fleet straggler signal must
+        reflect the device, not the rescue ladder), or ``None`` once
         the budget is exhausted — the chip is excluded from THIS answer
         and ChipHealth decides quarantine.
 
@@ -669,9 +698,15 @@ class ShardedPartitionSet:
         lock, so hedges and retries stay live), while the merge itself
         runs INSIDE it — a ``PartitionSet`` is not thread-safe, so an
         abandoned attempt finishing late must never interleave with a
-        sibling or a later merge on the same group. A genuinely wedged
-        kernel holds the lock; every rescue then blocks behind it and
-        the deadline exclusion is the only way out, which is the point.
+        sibling or a later merge on the same group. On a deadline
+        timeout ``done`` is set before the exclusion is returned, so a
+        still-parked attempt bows out at the lock check instead of
+        merging a group the main thread has moved on from; an attempt
+        already computing inside the lock is serialized against later
+        ingest/flush/failover, which all take the chip lock. A
+        genuinely wedged kernel holds the lock; every rescue then
+        blocks behind it and the deadline exclusion is the only way
+        out, which is the point.
 
         An unscoped ``InjectedCrash`` models a PROCESS death and
         re-raises on the calling thread; a chip-scoped one models this
@@ -690,16 +725,18 @@ class ShardedPartitionSet:
             slot: dict = {}
 
             def run(done=done, slot=slot):
+                s0 = time.perf_counter_ns()
                 try:
                     fault_point("sharded.chip_merge", chip=c)
                     with self._chip_locks[c]:
                         if done.is_set():
-                            return  # a sibling attempt already won
+                            return  # a sibling won or the deadline passed
                         r = self._level1_chip(c, chip, want_prune)
+                        s1 = time.perf_counter_ns()
                 except BaseException as e:  # InjectedCrash included
                     slot.setdefault("err", e)
                 else:
-                    slot.setdefault("ok", r)
+                    slot.setdefault("ok", (r, s0, s1))
                 finally:
                     done.set()
 
@@ -729,6 +766,12 @@ class ShardedPartitionSet:
                 if self._health is not None:
                     self._health.note_merge_error(c, reason)
             else:
+                # abandon the in-flight attempt(s): a thread still parked
+                # at its fault point must see done set when it reaches the
+                # lock check, or it would run the full level-1 merge
+                # concurrently with whatever the main thread does next on
+                # this group (the exact slow-chip race this path targets)
+                done.set()
                 reason = f"deadline {deadline_ms:.0f}ms exceeded"
                 if self._health is not None:
                     self._health.note_merge_timeout(c, deadline_ms)
@@ -762,7 +805,15 @@ class ShardedPartitionSet:
             if owner is None:
                 self._fnote("sharded.failover_stalled", quarantined=quarantined)
                 break  # no healthy owner left; stay degraded
-            self.failover(c, owner)
+            try:
+                self.failover(c, owner)
+            except TimeoutError:
+                # a still-running merge attempt holds this chip's lock
+                # past the bounded wait: capturing the group's state now
+                # would tear it mid-merge, so stay degraded and retry at
+                # the next merge launch / idle tick (the flight note is
+                # written by failover itself)
+                continue
             healed.append(c)
         return healed
 
@@ -782,7 +833,18 @@ class ShardedPartitionSet:
         records since the last common barrier — the chip-local segment a
         physical re-owner must re-apply — and the newest journaled epoch
         digest, recorded in ``last_failover`` for the drill to verify
-        currency against."""
+        currency against.
+
+        The capture + swap run under the chip's merge lock: with
+        ``SKYLINE_CHIP_FAIL_THRESHOLD=1`` a single slow merge attempt
+        quarantines the chip while that attempt is still computing
+        inside the lock, and reading ``audit_state()`` concurrently
+        would tear the very state the byte-identical-post-heal
+        guarantee rides on. The wait is bounded
+        (``SKYLINE_CHIP_FAILOVER_LOCK_MS``) so a truly wedged kernel
+        cannot stall failover forever — on timeout this raises
+        ``TimeoutError`` and ``maybe_failover`` retries on a later
+        tick."""
         if owner is None:
             owner = next(
                 (
@@ -805,25 +867,39 @@ class ShardedPartitionSet:
                 window = self._chip_wal.failover_window(c)
             except (OSError, ValueError, KeyError):
                 window = None  # journal unreadable: heal without the audit
-        old = self._chips[c]
-        old_epoch = epoch_hex(old.epoch_key)
-        with self._dev(c):
-            skies, pendings = old.audit_state()
-        with jax.default_device(self._devices[owner]):
-            grp = PartitionSet(
-                self.group_size,
-                self.dims,
-                self.buffer_size,
-                initial_capacity=self._initial_capacity,
-                tracer=self.tracer,
-                flush_policy=self.flush_policy,
-                overlap_rows=self.overlap_rows,
-                window_capacity=self._window_capacity,
-                counters=self._counters,
+        lock = self._chip_locks[c]
+        wait_ms = failover_lock_ms()
+        if not lock.acquire(timeout=wait_ms / 1000.0):
+            self._inc("sharded.failover_lock_timeouts")
+            self._fnote(
+                "sharded.failover_lock_timeout", chip=c, wait_ms=wait_ms
             )
-            grp.restore_all(skies, pendings)
-        self._chips[c] = grp
-        self._devices[c] = self._devices[owner]
+            raise TimeoutError(
+                f"chip {c} merge lock still held after {wait_ms:.0f}ms; "
+                "failover deferred"
+            )
+        try:
+            old = self._chips[c]
+            old_epoch = epoch_hex(old.epoch_key)
+            with self._dev(c):
+                skies, pendings = old.audit_state()
+            with jax.default_device(self._devices[owner]):
+                grp = PartitionSet(
+                    self.group_size,
+                    self.dims,
+                    self.buffer_size,
+                    initial_capacity=self._initial_capacity,
+                    tracer=self.tracer,
+                    flush_policy=self.flush_policy,
+                    overlap_rows=self.overlap_rows,
+                    window_capacity=self._window_capacity,
+                    counters=self._counters,
+                )
+                grp.restore_all(skies, pendings)
+            self._chips[c] = grp
+            self._devices[c] = self._devices[owner]
+        finally:
+            lock.release()
         grp.attach_observability(profiler=self._profiler, flight=self._flight)
         self._gm_cache = None  # the cached two-level result is stale now
         wall_ms = (time.perf_counter_ns() - t0) / 1e6
@@ -1003,7 +1079,9 @@ class ShardedPartitionSet:
         skies: list[np.ndarray] = []
         pendings: list[np.ndarray] = []
         for c, chip in enumerate(self._chips):
-            with self._dev(c):
+            # serialized against any deadline-abandoned merge attempt
+            # still computing on this group (_bounded_level1)
+            with self._chip_locks[c], self._dev(c):
                 s, pd = chip.audit_state()
             skies.extend(s)
             pendings.extend(pd)
@@ -1015,7 +1093,7 @@ class ShardedPartitionSet:
         assert len(skies) == len(pendings) == self.num_partitions
         G = self.group_size
         for c, chip in enumerate(self._chips):
-            with self._dev(c):
+            with self._chip_locks[c], self._dev(c):
                 chip.restore_all(
                     skies[c * G : (c + 1) * G],
                     pendings[c * G : (c + 1) * G],

@@ -152,6 +152,21 @@ def chip_merge_deadline_ms() -> float:
     return max(0.0, env_float("SKYLINE_CHIP_MERGE_DEADLINE_MS", 0.0))
 
 
+def failover_lock_ms() -> float:
+    """``SKYLINE_CHIP_FAILOVER_LOCK_MS``: bounded wait for a chip's merge
+    lock before ``failover`` captures the group's state. A slow merge
+    attempt may still be computing inside the lock when its chip
+    quarantines (``SKYLINE_CHIP_FAIL_THRESHOLD=1`` makes this the COMMON
+    case); failover must wait it out — ``audit_state`` read concurrently
+    would tear the state byte-identical healing rides on — but a truly
+    wedged kernel must not stall failover forever, so past this bound
+    the attempt is abandoned for this tick and retried at the next
+    merge launch / worker idle tick. Read lazily per failover."""
+    from skyline_tpu.analysis.registry import env_float
+
+    return max(0.0, env_float("SKYLINE_CHIP_FAILOVER_LOCK_MS", 5000.0))
+
+
 def chip_failover_enabled() -> bool:
     """``SKYLINE_CHIP_FAILOVER`` gates online partition-group failover
     (``distributed/sharded.py`` ``maybe_failover``): at merge-launch (and

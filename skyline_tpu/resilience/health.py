@@ -7,7 +7,8 @@ signals into a DECISION: every chip carries a health score in [0, 1]
 (1 = pristine), fed by merge outcomes:
 
 - a completed level-1 merge recovers the score toward 1 and refreshes the
-  chip's heartbeat;
+  chip's heartbeat (completed per-chip flushes refresh the heartbeat too,
+  so merge-quiet but ingest-live chips never quarantine stale);
 - a deadline timeout or an error (including a chip-scoped injected
   crash) halves the score and bumps a consecutive-failure counter;
 - a merge wall creeping past ``SKYLINE_CHIP_STRAGGLER_FACTOR`` × the
@@ -95,7 +96,13 @@ class ChipHealth:
     # -- signal intake ----------------------------------------------------
 
     def note_heartbeat(self, chip: int) -> None:
-        self._rec[chip].heartbeat_s = time.monotonic()
+        """Liveness proof between merges: the sharded facade calls this
+        on every completed per-chip flush (``ShardedPartitionSet.
+        flush_all``), so an ingest-heavy chip that merges rarely never
+        quarantines stale; merges refresh the heartbeat too
+        (``note_merge_ok`` / ``heal``)."""
+        with self._lock:
+            self._rec[chip].heartbeat_s = time.monotonic()
 
     def note_merge_ok(self, chip: int, wall_ms: float) -> None:
         """A completed level-1 merge: recover the score, refresh the

@@ -709,9 +709,11 @@ class SkylineEngine:
             result["partial"] = True
             result["missing_partitions"] = partial_missing
         if degraded is not None:
-            # chip-level degradation (RUNBOOK §2p): the answer is a sound
-            # SUBSET of the truth — surviving chips' union — marked with
-            # who is missing and how much mass the bound guarantees
+            # chip-level degradation (RUNBOOK §2p): the answer is the
+            # EXACT skyline of the surviving chips' records (NOT a
+            # subset of the truth — a point dominated only by
+            # excluded-chip data legitimately appears), marked with who
+            # is missing and how much record mass the bound guarantees
             result["partial"] = True
             result["excluded_chips"] = degraded["excluded_chips"]
             result["completeness_bound"] = degraded["completeness_bound"]

@@ -217,6 +217,108 @@ def test_failover_grid(rng, monkeypatch, d, chips, action):
     assert_same_merge(base, post, ctx=f"post-heal {ctx}")
 
 
+def test_abandoned_attempt_bows_out_after_deadline(rng, monkeypatch):
+    """A deadline-abandoned attempt (parked on a slow fault point) must
+    NOT run the level-1 merge when it finally wakes: the main thread has
+    moved on, so a late merge would race ingest/flush on the same
+    non-thread-safe group. The timeout path sets ``done`` before
+    excluding the chip, and the stale thread bows out at the lock
+    check."""
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    _feed(sp, x)
+    merge_state(sp)  # warm
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "300")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_RETRIES", "0")
+    monkeypatch.setenv("SKYLINE_FAULT_SLOW_MS", "1200")
+    install_plan(FaultPlan.parse("slow@sharded.chip_merge#1:1"))
+    sp._gm_cache = None
+    chip = sp._chips[1]
+    launches_before = chip.merge_cache_hits + chip.merge_cache_misses
+    sp.global_merge_stats()
+    assert sp.last_partial is not None  # the deadline excluded chip 1
+    clear()
+    _join_abandoned(1)
+    # the woken thread saw done set and returned without merging
+    assert (
+        chip.merge_cache_hits + chip.merge_cache_misses == launches_before
+    ), "abandoned attempt ran the merge after the deadline excluded it"
+
+
+def test_failover_waits_out_chip_lock_then_defers(rng, monkeypatch):
+    """Failover must not capture a group's state while a merge attempt
+    holds the chip lock (torn ``audit_state`` would break the
+    byte-identical-post-heal guarantee): past the bounded wait it
+    defers — chip stays quarantined, no swap — and succeeds on a later
+    tick once the lock frees."""
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    _feed(sp, x)
+    health.quarantine(1, "drill")
+    monkeypatch.setenv("SKYLINE_CHIP_FAILOVER_LOCK_MS", "100")
+    assert sp._chip_locks[1].acquire(timeout=1.0)  # a merge "in flight"
+    try:
+        assert sp.maybe_failover() == []
+        assert health.quarantined() == [1]
+        assert sp.failovers == 0
+    finally:
+        sp._chip_locks[1].release()
+    assert sp.maybe_failover() == [1]
+    assert health.quarantined() == []
+    assert sp.failovers == 1
+
+
+def test_bounded_wall_excludes_retry_backoff(rng, monkeypatch):
+    """The wall fed to ChipHealth/fleet must be the winning attempt's
+    own merge wall, not the whole rescue ladder: a chip that succeeds on
+    a retry must not inherit the backoff sleep as an inflated EMA (which
+    would read scheduler overhead as device slowness and poison the
+    peer-median straggler signal)."""
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    _feed(sp, x)
+    merge_state(sp)  # warm: compile walls land here, before health attaches
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_DEADLINE_MS", "5000")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_RETRIES", "1")
+    monkeypatch.setenv("SKYLINE_CHIP_MERGE_BACKOFF_MS", "500")
+    # first attempt dies instantly (chip-scoped crash), retry succeeds
+    # after the 500 ms backoff sleep
+    install_plan(FaultPlan.parse("crash@sharded.chip_merge#1:1"))
+    sp._gm_cache = None
+    sp.global_merge_stats()
+    clear()
+    assert sp.last_partial is None  # the retry rescued the answer
+    rec = health.doc()["per_chip"][1]
+    assert rec["merges_ok"] >= 1
+    assert rec["wall_ema_ms"] is not None and rec["wall_ema_ms"] < 400, (
+        f"backoff sleep leaked into the scored wall: {rec['wall_ema_ms']}"
+    )
+
+
+def test_flush_refreshes_health_heartbeat(rng):
+    """Completed per-chip flushes are the between-merge liveness feed:
+    a chip that ingests but rarely merges must not quarantine stale."""
+    d = 2
+    x = gen_points(rng, 200, d, "uniform")
+    sp = ShardedPartitionSet(P, d, 64, chips=2)
+    health = ChipHealth(2)
+    sp.attach_health(health)
+    for r in health._rec:
+        r.heartbeat_s -= 100.0  # long-idle fleet
+    _feed(sp, x)  # ingest + flush on every chip
+    for rec in health.doc()["per_chip"]:
+        assert rec["heartbeat_age_s"] < 50.0, (
+            f"flush did not refresh chip {rec['chip']}'s heartbeat"
+        )
+
+
 def test_unscoped_crash_in_bounded_merge_is_process_death(rng, monkeypatch):
     """An UNSCOPED crash clause must escape the watchdog — it models the
     process dying, and absorbing it as a chip fault would hide a real
