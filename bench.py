@@ -557,6 +557,69 @@ def serve_leg(d: int, algo: str) -> dict:
     }
 
 
+def replica_leg(d: int) -> dict:
+    """Replica-plane microbenchmark: WAL tail-to-serve lag (ISSUE 15).
+
+    Builds a primary-side SnapshotStore whose publish hook appends the
+    byte-exact delta record to a WAL, attaches one live ``SkylineReplica``
+    tailing that WAL, publishes ``BENCH_REPLICA_PUBLISHES`` transitions,
+    and reports the replica's ``replica_tail_lag_ms`` percentiles — the
+    publish-stamp-to-apply lag the scripts/bench_compare.py sentinel gates
+    as ``replica.read_lag_p99_ms``. Byte identity at the final common
+    version is asserted into the block (a lag number from a diverged
+    replica would be meaningless).
+    """
+    import shutil
+    import tempfile
+
+    from skyline_tpu.resilience.wal import WalWriter
+    from skyline_tpu.serve import SnapshotStore, delta_wal_record
+    from skyline_tpu.serve.replica import SkylineReplica
+
+    n_pub = env_int("BENCH_REPLICA_PUBLISHES", 40)
+    rows = env_int("BENCH_REPLICA_ROWS", 2048)
+    tmp = tempfile.mkdtemp(prefix="bench-replica-")
+    writer = store = replica = None
+    try:
+        writer = WalWriter(tmp, fsync="off")
+
+        def shadow(prev, snap):
+            writer.append(delta_wal_record(prev, snap))
+            writer.flush(force=True)
+
+        store = SnapshotStore()
+        store.on_publish(shadow)
+        replica = SkylineReplica(tmp, poll_interval_s=0.001)
+        rng = np.random.default_rng(7)
+        for _ in range(n_pub):
+            store.publish(rng.random((rows, d), dtype=np.float32))
+        converged = replica.wait_for_version(store.head_version, timeout_s=30.0)
+        lag = replica.telemetry.histogram("replica_tail_lag_ms", unit="ms")
+        pcts = lag.percentiles(50, 99)
+        identical = bool(
+            converged
+            and replica.store.latest().points.tobytes()
+            == store.latest().points.tobytes()
+        )
+        return {
+            "read_lag_p50_ms": round(pcts["p50"], 2),
+            "read_lag_p99_ms": round(pcts["p99"], 2),
+            "publishes": n_pub,
+            "rows_per_snapshot": rows,
+            "records_applied": replica.records_applied,
+            "head_version": replica.store.head_version,
+            "converged": converged,
+            "byte_identical": identical,
+            "rebootstraps": replica.rebootstraps,
+        }
+    finally:
+        if replica is not None:
+            replica.close()
+        if writer is not None:
+            writer.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def child_main(backend: str) -> None:
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -669,6 +732,14 @@ def child_main(backend: str) -> None:
             serve = {"error": f"{type(e).__name__}: {e}"}
     else:
         serve = {"skipped": True}
+    # replica-plane leg: WAL tail-to-serve lag (BENCH_REPLICA=0 to skip)
+    if env_bool("BENCH_REPLICA", True):
+        try:
+            replica = replica_leg(d)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            replica = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        replica = {"skipped": True}
     # lineage + kernel registry ride the artifact as top-level blocks so
     # scripts/bench_compare.py can gate on freshness.read_lag_p99_ms
     freshness = serve.pop("freshness", {"skipped": True})
@@ -746,6 +817,7 @@ def child_main(backend: str) -> None:
                 "flush_policy": cfg.flush_policy,
                 "rank_cascade": rank_cascade_stamp(),
                 "serve": serve,
+                "replica": replica,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,

@@ -9,11 +9,14 @@
 #     wal.replayed > 0, checkpoint.saved >= 1,
 #   * skyline_resilience_restarts_total reaches the Prometheus exposition.
 #
-# Then two follow-on drills: the audit-divergence drill (corrupt a
-# published snapshot, prove the shadow-verification plane catches it) and
+# Then three follow-on drills: the audit-divergence drill (corrupt a
+# published snapshot, prove the shadow-verification plane catches it),
 # the chip fault-tolerance drill (slow chip + chip-kill under a merge
 # deadline: honest degraded answer -> quarantine -> online failover ->
-# healed byte-identical; RUNBOOK §2p).
+# healed byte-identical; RUNBOOK §2p), and the replica drill (kill the
+# engine under WAL-tailing read replicas: answers stay byte-identical
+# and honestly fenced, then reconverge through the tail alone after the
+# engine restarts; RUNBOOK §2q).
 #
 #   scripts/chaos_smoke.sh
 #
@@ -288,4 +291,106 @@ for action in ("slow", "crash"):
           f"({wall_ms:.0f}ms, marked partial) -> quarantined -> failover "
           f"(owner={lf['owner']}, {lf['wall_ms']:.1f}ms) -> healed "
           f"byte-identical")
+EOF
+
+# replica drill (RUNBOOK §2q): two WAL-tailing read replicas — one with a
+# generous staleness fence, one with a tight 300ms fence — track a primary
+# byte-for-byte; killing the engine mid-burst must leave the generous
+# replica serving monotonically aging, honestly-watermarked answers while
+# the fenced replica refuses with 503s; restarting the engine must
+# reconverge both through the tail alone (no re-bootstrap)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import hashlib
+import shutil
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from skyline_tpu.resilience.wal import WalWriter
+from skyline_tpu.serve import SkylineServer, SnapshotStore, delta_wal_record
+from skyline_tpu.serve.replica import SkylineReplica
+
+
+def get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+wal_dir = tempfile.mkdtemp(prefix="skyline-replica-drill-")
+rng = np.random.default_rng(23)
+writer = WalWriter(wal_dir, fsync="off")
+
+
+def shadow(prev, snap):
+    writer.append(delta_wal_record(prev, snap))
+    writer.flush(force=True)
+
+
+store = SnapshotStore()
+store.on_publish(shadow)
+primary = SkylineServer(store, port=0)
+rep_a = SkylineReplica(wal_dir, replica_id="rep-a",
+                       poll_interval_s=0.005, start=True)
+rep_b = SkylineReplica(wal_dir, replica_id="rep-b",
+                       poll_interval_s=0.005, max_stale_ms=300.0, start=True)
+try:
+    # burst: every version must be byte-identical on both replicas
+    for v in range(1, 7):
+        store.publish(rng.random((96, 4)).astype(np.float32))
+        assert rep_a.wait_for_version(v, timeout_s=10.0)
+        assert rep_b.wait_for_version(v, timeout_s=10.0)
+        _, pb, ph = get(f"http://127.0.0.1:{primary.port}/skyline?format=csv")
+        for rep in (rep_a, rep_b):
+            _, rb, rh = get(f"http://127.0.0.1:{rep.port}/skyline?format=csv")
+            assert rh["X-Skyline-Version"] == ph["X-Skyline-Version"]
+            assert hashlib.sha256(rb).hexdigest() == \
+                hashlib.sha256(pb).hexdigest(), f"replica bytes diverged @v{v}"
+    # ---- kill the engine ----
+    writer.close()
+    primary.close()
+    import json
+    stales = []
+    for _ in range(4):
+        code, body, _ = get(f"http://127.0.0.1:{rep_a.port}/skyline?points=0")
+        assert code == 200
+        stales.append(json.loads(body)["staleness_ms"])
+        time.sleep(0.05)
+    assert stales == sorted(stales) and stales[-1] > stales[0], stales
+    time.sleep(0.35)  # let rep-b age past its 300ms fence
+    code, body, _ = get(f"http://127.0.0.1:{rep_b.port}/skyline?points=0")
+    assert code == 503 and json.loads(body)["stale"] is True, code
+    # ---- engine restarts: fresh WAL incarnation, same snapshot chain ----
+    writer2 = WalWriter(wal_dir, fsync="off")
+
+    def shadow2(prev, snap):
+        writer2.append(delta_wal_record(prev, snap))
+        writer2.flush(force=True)
+
+    store._subscribers = [shadow2]
+    try:
+        for v in range(7, 10):
+            store.publish(rng.random((96, 4)).astype(np.float32))
+        assert rep_a.wait_for_version(9, timeout_s=10.0)
+        assert rep_b.wait_for_version(9, timeout_s=10.0)
+        for rep in (rep_a, rep_b):
+            assert rep.rebootstraps == 0 and rep.bootstraps == 1
+            assert rep.store.latest().points.tobytes() == \
+                store.latest().points.tobytes(), "post-restart divergence"
+        code, _, _ = get(f"http://127.0.0.1:{rep_b.port}/skyline?points=0")
+        assert code == 200  # fence clears with fresh data
+    finally:
+        writer2.close()
+finally:
+    rep_a.close()
+    rep_b.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+print("[chaos-smoke] replica drill ok: 6 versions byte-identical on 2 "
+      "replicas -> engine killed -> honest aging + fenced 503 -> restart "
+      "-> reconverged via tail (no re-bootstrap)")
 EOF

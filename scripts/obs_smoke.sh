@@ -185,7 +185,8 @@ try:
     assert slo["ok"] is True, slo
     assert set(slo["slos"]) == {"read_p99", "freshness_p99",
                                 "shed_fraction", "restart_rate",
-                                "audit_divergence"}, slo
+                                "audit_divergence", "degraded_answers",
+                                "tenant_shed_fraction"}, slo
     for name, s in slo["slos"].items():
         assert {"fast", "slow"} <= set(s["windows"]), (name, s)
         assert s["breach"] is False, (name, s)
@@ -402,6 +403,119 @@ assert off_counters.get("flush.sorted_sfs", 0) == 0, off_counters
 print(f"[obs-smoke] sorted-SFS digest ok: g={digests['on'][0]} identical "
       f"with cascade on ({on_counters['flush.sorted_sfs']:.0f} sorted "
       "flush(es)) and off")
+EOF
+
+# replicated read fleet (RUNBOOK §2q): a WAL-tailing replica must expose
+# the full serve surface byte-identically (role-marked /healthz, labeled
+# per-tenant admission families on /metrics, SSE delta push on
+# /subscribe) and the perf sentinel must watch replica read lag
+JAX_PLATFORMS=cpu python - <<'EOF'
+import hashlib
+import json
+import shutil
+import socket
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from skyline_tpu.resilience.wal import WalWriter
+from skyline_tpu.serve import (
+    ServeConfig,
+    SkylineServer,
+    SnapshotStore,
+    delta_wal_record,
+)
+from skyline_tpu.serve.replica import SkylineReplica
+from skyline_tpu.telemetry.sentinel import DEFAULT_RULES
+
+assert any(r["label"] == "replica.read_lag_p99_ms" for r in DEFAULT_RULES), \
+    "sentinel does not watch replica read lag"
+
+wal_dir = tempfile.mkdtemp(prefix="skyline-replica-obs-")
+rng = np.random.default_rng(31)
+writer = WalWriter(wal_dir, fsync="off")
+
+
+def shadow(prev, snap):
+    writer.append(delta_wal_record(prev, snap))
+    writer.flush(force=True)
+
+
+store = SnapshotStore()
+store.on_publish(shadow)
+primary = SkylineServer(store, port=0)
+cfg = ServeConfig(tenant_rate=0.001, tenant_burst=2)
+rep = SkylineReplica(wal_dir, serve_config=cfg, replica_id="obs-rep",
+                     poll_interval_s=0.005, start=True)
+try:
+    store.publish(rng.random((64, 3)).astype(np.float32))
+    assert rep.wait_for_version(1, timeout_s=10.0)
+
+    # role-marked health + byte identity with the primary
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/healthz", timeout=5
+    ) as r:
+        assert json.load(r)["role"] == "replica"
+    bodies = []
+    for port in (primary.port, rep.port):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/skyline?format=csv", timeout=5
+        ) as r:
+            bodies.append(hashlib.sha256(r.read()).hexdigest())
+    assert bodies[0] == bodies[1], "replica served different bytes"
+
+    # SSE: subscribe, publish, the delta must be pushed
+    sk = socket.create_connection(("127.0.0.1", rep.port), timeout=10)
+    sk.sendall(b"GET /subscribe HTTP/1.1\r\nHost: x\r\n\r\n")
+    f = sk.makefile("rb")
+    while f.readline().strip():  # drain response headers
+        pass
+    deadline = time.monotonic() + 5.0
+    while not rep.server._sse_queues:  # registration is async
+        assert time.monotonic() < deadline, "SSE subscriber never registered"
+        time.sleep(0.01)
+    store.publish(rng.random((64, 3)).astype(np.float32))
+    assert rep.wait_for_version(2, timeout_s=10.0)
+    event = None
+    while event is None:
+        line = f.readline()
+        if line.startswith(b"event:"):
+            event = line.split(b":", 1)[1].strip().decode()
+    assert event == "delta", event
+    sk.close()
+
+    # per-tenant admission: burst tenant "t1" past its 2-token bucket,
+    # then the labeled shed family must appear on the replica's /metrics
+    shed = 0
+    for _ in range(6):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rep.port}/skyline?points=0",
+            headers={"X-Tenant": "t1"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5).read()
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            shed += 1
+    assert shed >= 1, "tenant bucket never shed"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/metrics", timeout=5
+    ) as r:
+        prom = r.read().decode()
+    assert 'skyline_serve_tenant_reads_shed_total{tenant="t1"}' in prom, \
+        "labeled tenant shed family missing from replica exposition"
+    assert 'skyline_serve_tenant_reads_admitted_total{tenant="t1"}' in prom
+    print(f"[obs-smoke] replica surface ok: byte-identical read, "
+          f"role-marked healthz, SSE delta push, {shed} tenant shed(s) "
+          f"labeled on /metrics, sentinel watches read lag")
+finally:
+    rep.close()
+    primary.close()
+    writer.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
 EOF
 
 # regression gate: newest two artifacts must currently pass at default

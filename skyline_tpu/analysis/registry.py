@@ -280,6 +280,32 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SERVE_HEADER_TIMEOUT_S", "float", 10.0,
        "per-connection wait for a complete request header block", "serve",
        runbook="§2d"),
+    _k("SKYLINE_SERVE_SSE_QUEUE", "int", 64,
+       "per-subscriber event queue for GET /subscribe; a subscriber that "
+       "falls further behind is drained and sent a resync event", "serve",
+       runbook="§2q"),
+    _k("SKYLINE_SERVE_TENANT_RATE", "float", 0.0,
+       "per-tenant snapshot-read token rate per second, keyed on the "
+       "X-Tenant header (0 = no per-tenant limit)", "job flag",
+       runbook="§2q", job_field="serve_tenant_rate"),
+    _k("SKYLINE_SERVE_TENANT_BURST", "int", 64,
+       "per-tenant snapshot-read token bucket capacity", "job flag",
+       runbook="§2q", job_field="serve_tenant_burst"),
+    _k("SKYLINE_REPLICAS", "int", 0,
+       "WAL-tailing read replicas spawned in-process by the worker "
+       "(requires --checkpoint-dir and --serve)", "job flag",
+       runbook="§2q", job_field="replicas"),
+    _k("SKYLINE_REPLICA_OF", "str", "",
+       "run as a standalone read replica tailing this WAL directory "
+       "instead of a worker (mutually exclusive with --replicas)",
+       "job flag", runbook="§2q", job_field="replica_of"),
+    _k("SKYLINE_REPLICA_MAX_STALE_MS", "float", 30_000.0,
+       "replica staleness fence: reads whose snapshot is older than this "
+       "are refused with 503 + Retry-After instead of served silently "
+       "stale", "serve", runbook="§2q"),
+    _k("SKYLINE_REPLICA_POLL_MS", "float", 25.0,
+       "replica WAL tail poll interval when no new frames are available",
+       "serve", runbook="§2q"),
     _k("SKYLINE_TRACE_OUT", "str", "",
        "write the span ring as Chrome trace-event JSON on shutdown",
        "job flag", runbook="§2b", job_field="trace_out"),
@@ -307,6 +333,11 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_WAL_SEGMENT_BYTES", "int", 4_194_304,
        "WAL segment rotation size", "job flag", runbook="§2i",
        job_field="wal_segment_bytes"),
+    _k("SKYLINE_WAL_TAILER_TTL_S", "float", 600.0,
+       "staleness TTL on replica tail acks: barrier() keeps segments a "
+       "live tailer hasn't consumed, but an ack older than this stops "
+       "pinning retention (dead replica protection)", "resilience",
+       runbook="§2q"),
     # -- resilience runtime (skyline_tpu/resilience) -----------------------
     _k("SKYLINE_FAULT_PLAN", "str", None,
        "deterministic fault-injection plan, e.g. crash@flush.pre_merge:3 "
@@ -419,6 +450,9 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SLO_DEGRADED_ANSWERS", "float", 0.01,
        "SLO target: max fraction of answered queries published "
        "chip-degraded (marked partial)", "telemetry/slo", runbook="§2p"),
+    _k("SKYLINE_SLO_TENANT_SHED", "float", 0.05,
+       "SLO target: max fraction of tenant-attributed read attempts shed "
+       "by the per-tenant buckets", "telemetry/slo", runbook="§2q"),
     _k("SKYLINE_FLEET", "bool", True,
        "per-chip fleet plane on the sharded engine: skyline_chip_* "
        "labeled metric families, imbalance index + skew ring, per-chip "
@@ -493,6 +527,12 @@ KNOBS: tuple[Knob, ...] = (
        "bench"),
     _k("BENCH_SERVE_READS", "int", 25, "serve-leg reads per reader",
        "bench"),
+    _k("BENCH_REPLICA", "bool", True, "run the replica-plane bench leg",
+       "bench", runbook="§2q"),
+    _k("BENCH_REPLICA_PUBLISHES", "int", 40,
+       "replica-leg publish transitions tailed", "bench"),
+    _k("BENCH_REPLICA_ROWS", "int", 2048,
+       "replica-leg rows per published snapshot", "bench"),
     _k("BENCH_SERVE_POINTS", "bool", False,
        "serve-leg full-payload reads instead of metadata-only", "bench"),
     _k("BENCH_COMPILE_CACHE", "str", None,

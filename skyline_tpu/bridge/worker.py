@@ -47,6 +47,7 @@ class SkylineWorker:
         trace_out: str | None = None,
         jax_profile_dir: str | None = None,
         resilience=None,
+        replicas: int = 0,
     ):
         """``mesh``: optional ``jax.sharding.Mesh`` — partition state shards
         across its devices (multi-chip streaming). ``mesh_chips``: > 0
@@ -257,6 +258,7 @@ class SkylineWorker:
         if resilience is not None:
             if self._snap_store is not None:
                 self._restore_serve(wal_records)
+            from skyline_tpu.analysis.registry import env_float
             from skyline_tpu.resilience.wal import WalWriter
 
             self._wal = WalWriter(
@@ -264,6 +266,10 @@ class SkylineWorker:
                 segment_bytes=resilience.wal_segment_bytes,
                 fsync=resilience.wal_fsync,
                 telemetry=self.telemetry,
+                # live replica tailers pin segment retention (barrier skips
+                # segments they haven't consumed); stale acks expire so a
+                # dead replica can't pin the log forever
+                tailer_ttl_s=env_float("SKYLINE_WAL_TAILER_TTL_S", 600.0),
             )
             # chip-local WAL segments for the sharded engine: per-chip
             # flush lineage + merge-time consistency barriers (policy
@@ -302,6 +308,29 @@ class SkylineWorker:
                 }
             )
             self._wal.flush(force=True)
+        # WAL-tailing read replicas (serve/replica.py): each gets its own
+        # SnapshotStore + ring + HTTP port, bootstraps from the newest
+        # barrier in the WAL and live-tails publish deltas. In-process
+        # spawn is the embedded/test mode; production runs them as separate
+        # processes (--replica-of) so an engine death leaves them serving.
+        self.replicas = []
+        if replicas:
+            if resilience is None or self._snap_store is None:
+                raise ValueError(
+                    "replicas require resilience (--checkpoint-dir) and the "
+                    "serve plane (--serve)"
+                )
+            from skyline_tpu.serve.replica import SkylineReplica
+
+            for i in range(int(replicas)):
+                self.replicas.append(
+                    SkylineReplica(
+                        self._wal_dir,
+                        port=0,
+                        serve_config=serve_config,
+                        replica_id=f"replica-{i}",
+                    )
+                )
         self.stats_server = None
         if stats_port is not None:
             from skyline_tpu.metrics.httpstats import StatsServer
@@ -368,6 +397,8 @@ class SkylineWorker:
             self.stats_server.close()
         if self.serve_server is not None:
             self.serve_server.close()
+        for replica in getattr(self, "replicas", []):
+            replica.close()
         if self._wal is not None:
             try:
                 self._wal.close()
@@ -508,14 +539,14 @@ class SkylineWorker:
     def _restore_serve(self, records: list) -> None:
         """Re-seat the serving plane from the WAL: head points from the last
         checkpoint barrier's inlined snapshot plus every delta after it
-        (set-exact; the next live publish restores canonical byte order),
-        the delta ring from the same delta records, version numbering
+        (byte-exact — delta records carry the published row order), the
+        delta ring from the same delta records, version numbering
         continuous. Until a live publish lands, reads carry
         ``"restored": true``."""
         import numpy as np
 
         from skyline_tpu.resilience.wal import rows_from_b64
-        from skyline_tpu.serve.deltas import Delta, _row_keys
+        from skyline_tpu.serve.deltas import Delta, apply_delta_record
 
         base = None
         base_idx = -1
@@ -536,6 +567,7 @@ class SkylineWorker:
         version = int(base["version"]) if base is not None else 0
         watermark = int(base.get("watermark_id", -1)) if base is not None else -1
         event_wm = base.get("event_wm_ms") if base is not None else None
+        meta = dict(base.get("meta", {})) if base is not None else {}
         ring_deltas = []
         for rec in delta_recs:
             entered = rows_from_b64(rec["entered"], int(rec["d"]))
@@ -543,18 +575,14 @@ class SkylineWorker:
             ring_deltas.append(
                 Delta(int(rec["from"]), int(rec["to"]), entered, left)
             )
-            if left.shape[0] and points.shape[0]:
-                points = points[~np.isin(_row_keys(points), _row_keys(left))]
-            if entered.shape[0]:
-                points = (
-                    np.concatenate([points, entered])
-                    if points.shape[0] else entered
-                )
+            points = apply_delta_record(points, rec)
             version = int(rec["to"])
             watermark = int(rec.get("wm", watermark))
             event_wm = rec.get("ewm", event_wm)
+            meta = dict(rec.get("meta", {}))
         self._snap_store.restore_state(
-            points, version, watermark_id=watermark, event_wm_ms=event_wm
+            points, version, watermark_id=watermark, event_wm_ms=event_wm,
+            meta=meta,
         )
         if event_wm is not None:
             # the engine's tracker resumes from the recovered watermark, so
@@ -576,29 +604,9 @@ class SkylineWorker:
         subscribers survive a restart (the delta ring's WAL shadow)."""
         if self._wal is None:
             return
-        import numpy as np
+        from skyline_tpu.serve.deltas import delta_wal_record
 
-        from skyline_tpu.resilience.wal import rows_to_b64
-        from skyline_tpu.serve.deltas import snapshot_delta
-
-        entered, left = snapshot_delta(
-            prev.points
-            if prev is not None
-            else np.empty((0, snap.points.shape[1]), dtype=np.float32),
-            snap.points,
-        )
-        rec = {
-            "type": "delta",
-            "from": prev.version if prev is not None else 0,
-            "to": snap.version,
-            "wm": snap.watermark_id,
-            "d": int(snap.points.shape[1]),
-            "entered": rows_to_b64(entered),
-            "left": rows_to_b64(left),
-        }
-        if snap.event_wm_ms is not None:
-            rec["ewm"] = snap.event_wm_ms  # freshness lineage survives restart
-        self._wal.append(rec)
+        self._wal.append(delta_wal_record(prev, snap))
 
     def _barrier_record(self) -> dict:
         rec = {
@@ -610,17 +618,9 @@ class SkylineWorker:
             self._snap_store.latest() if self._snap_store is not None else None
         )
         if snap is not None:
-            from skyline_tpu.resilience.wal import rows_to_b64
+            from skyline_tpu.serve.deltas import snapshot_wal_record
 
-            rec["snap"] = {
-                "version": snap.version,
-                "watermark_id": snap.watermark_id,
-                "timestamp_ms": snap.timestamp_ms,
-                "d": int(snap.points.shape[1]),
-                "rows": rows_to_b64(snap.points),
-            }
-            if snap.event_wm_ms is not None:
-                rec["snap"]["event_wm_ms"] = snap.event_wm_ms
+            rec["snap"] = snapshot_wal_record(snap)
         return rec
 
     def checkpoint_now(self) -> str | None:
@@ -959,6 +959,16 @@ def main(argv=None):
     from skyline_tpu.utils.config import parse_job_args
 
     cfg = parse_job_args(argv)
+    if cfg.replica_of:
+        # standalone read replica: no Kafka, no engine — bootstrap from the
+        # primary's WAL directory and tail it until signalled
+        from skyline_tpu.serve.replica import run_replica
+
+        return run_replica(
+            cfg.replica_of,
+            port=cfg.serve_port if cfg.serve_port >= 0 else 0,
+            serve_config=cfg.serve_config(),
+        )
     # restarted workers reuse every previously compiled executable
     # (SKYLINE_COMPILE_CACHE overrides the location)
     enable_compile_cache()
@@ -982,6 +992,7 @@ def main(argv=None):
         trace_out=cfg.trace_out or None,
         jax_profile_dir=cfg.jax_profile_dir or None,
         resilience=cfg.resilience_config(),
+        replicas=cfg.replicas,
     )
     print(
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
@@ -989,7 +1000,12 @@ def main(argv=None):
         f" chips={cfg.mesh_chips or 'off'}"
         + (f" stats=:{worker.stats_server.port}" if worker.stats_server else "")
         + (f" serve=:{worker.serve_server.port}" if worker.serve_server else "")
-        + (f" checkpoints={cfg.checkpoint_dir}" if cfg.checkpoint_dir else ""),
+        + (f" checkpoints={cfg.checkpoint_dir}" if cfg.checkpoint_dir else "")
+        + (
+            " replicas=" + ",".join(f":{r.port}" for r in worker.replicas)
+            if getattr(worker, "replicas", None)
+            else ""
+        ),
         file=sys.stderr,
     )
     try:

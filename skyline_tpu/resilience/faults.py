@@ -49,6 +49,9 @@ KILL_POINTS = frozenset(
         "kafka.poll",  # bridge/worker.py step() poll entry
         "audit.corrupt",  # serve/snapshot.py publish body byte-flip
         "sharded.chip_merge",  # distributed/sharded.py per-chip merge entry
+        "replica.tail",  # serve/replica.py tail-loop iteration entry
+        "replica.restore",  # serve/replica.py bootstrap entry
+        "wal.rotate_during_tail",  # resilience/wal.py segment rotation
     )
 )
 

@@ -37,6 +37,7 @@ import json
 import os
 import struct
 import sys
+import time
 import zlib
 
 from skyline_tpu.resilience.faults import fault_point
@@ -44,6 +45,7 @@ from skyline_tpu.resilience.faults import fault_point
 _SEGMENT_MAGIC = b"SKWL1\n"
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 _SEGMENT_FMT = "wal-%08d.log"
+_ACK_FMT = "tail-%s.ack"
 FSYNC_POLICIES = ("always", "batch", "off")
 
 
@@ -54,6 +56,19 @@ class WalError(Exception):
 class WalReplayError(WalError):
     """Recovery found the WAL and the bus in disagreement (gap in the
     recorded spans, bus ended early, or a replay digest mismatch)."""
+
+
+class WalTailCorruption(WalError):
+    """The tailer hit a *complete* frame with a bad CRC / unparsable
+    payload, or a segment whose magic is wrong — definitive on-disk
+    corruption, not a crash artifact (``os.write`` leaves prefixes, never
+    full-length garbage frames). The tailer's owner must re-bootstrap."""
+
+
+class WalSegmentGone(WalError):
+    """The segment the tailer was mid-read on vanished (pruned under it).
+    The tailer's position is unrecoverable; re-bootstrap from the newest
+    barrier."""
 
 
 def batch_digest(ids, values) -> str:
@@ -106,6 +121,41 @@ def list_segments(directory: str) -> list[tuple[int, str]]:
     return out
 
 
+def _ack_files(directory: str) -> list[str]:
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return [
+        os.path.join(directory, n)
+        for n in names
+        if n.startswith("tail-") and n.endswith(".ack")
+    ]
+
+
+def tail_retention_floor(directory: str, ttl_s: float | None = None) -> int | None:
+    """Lowest segment any live tailer still needs, or ``None`` when no
+    tailer is registered. A tailer that acked segment N has fully consumed
+    everything < N+1, so its floor is ``acked + 1``. Ack files older than
+    ``ttl_s`` (mtime) belong to dead tailers and are ignored AND removed,
+    so an abandoned replica cannot pin retention forever."""
+    floor: int | None = None
+    now = time.time()
+    for path in _ack_files(directory):
+        try:
+            if ttl_s is not None and now - os.path.getmtime(path) > ttl_s:
+                os.unlink(path)
+                continue
+            with open(path, "r", encoding="utf-8") as f:
+                acked = int(json.load(f).get("seq", -1))
+        except (OSError, ValueError):
+            continue  # mid-replace or malformed: skip this tailer this round
+        need = acked + 1
+        if floor is None or need < floor:
+            floor = need
+    return floor
+
+
 class WalWriter:
     """Single-threaded appender (the worker's ingest thread owns it)."""
 
@@ -115,6 +165,7 @@ class WalWriter:
         segment_bytes: int = 4_194_304,
         fsync: str = "batch",
         telemetry=None,
+        tailer_ttl_s: float | None = None,
     ):
         if fsync not in FSYNC_POLICIES:
             raise ValueError(f"fsync must be one of {FSYNC_POLICIES}, got {fsync!r}")
@@ -122,9 +173,11 @@ class WalWriter:
         self.segment_bytes = max(int(segment_bytes), len(_SEGMENT_MAGIC) + 1)
         self.fsync_policy = fsync
         self._telemetry = telemetry
+        self.tailer_ttl_s = tailer_ttl_s
         self.appends = 0
         self.segments_created = 0
         self.segments_truncated = 0
+        self.segments_retained = 0
         self._fd: int | None = None
         self._seg_seq = 0
         self._seg_bytes = 0
@@ -139,6 +192,7 @@ class WalWriter:
         if self._fd is not None:
             self._fsync_if(self.fsync_policy != "off")
             os.close(self._fd)
+            fault_point("wal.rotate_during_tail")
         path = os.path.join(self.directory, _SEGMENT_FMT % seq)
         self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         os.write(self._fd, _SEGMENT_MAGIC)
@@ -179,12 +233,18 @@ class WalWriter:
         """Checkpoint barrier: rotate to a fresh segment, write ``rec``
         (type ``ckpt``) as its first record, fsync it (always — the
         truncation below deletes the only other copy of the serve head),
-        then delete every older segment. After a barrier the WAL's whole
-        content is: the barrier record + everything after the checkpoint."""
+        then delete every older segment a live tailer has already
+        consumed. Segments a registered tailer (``tail-*.ack``) still
+        needs are retained past the barrier — they get pruned by a later
+        barrier once the tailer acks past them (or its ack goes stale
+        per ``tailer_ttl_s``)."""
         self._open_segment(self._seg_seq + 1)
         keep = self._seg_seq
         self.append(rec)
         self._fsync()
+        floor = tail_retention_floor(self.directory, self.tailer_ttl_s)
+        if floor is not None and floor < keep:
+            keep = floor
         for seq, path in list_segments(self.directory):
             if seq < keep:
                 try:
@@ -195,6 +255,10 @@ class WalWriter:
                 self.segments_truncated += 1
                 if self._telemetry is not None:
                     self._telemetry.inc("wal.truncated")
+            elif seq < self._seg_seq:
+                self.segments_retained += 1
+                if self._telemetry is not None:
+                    self._telemetry.inc("wal.retained")
 
     def close(self) -> None:
         if self._fd is not None:
@@ -209,6 +273,7 @@ class WalWriter:
             "segment_bytes": self._seg_bytes,
             "segments_created": self.segments_created,
             "segments_truncated": self.segments_truncated,
+            "segments_retained": self.segments_retained,
             "fsync_policy": self.fsync_policy,
         }
 
@@ -250,3 +315,191 @@ def read_records(directory: str) -> tuple[list[dict], int]:
             torn += 1
             break
     return records, torn
+
+
+def segment_first_record(path: str) -> dict | None:
+    """Parse just the first frame of a segment (None when missing, torn,
+    or corrupt). Barrier segments carry the checkpoint record first, so
+    this is how a bootstrapping tailer finds the newest barrier without
+    replaying — or trusting — the history before it."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(_SEGMENT_MAGIC) + _FRAME.size)
+            if (
+                len(head) < len(_SEGMENT_MAGIC) + _FRAME.size
+                or head[: len(_SEGMENT_MAGIC)] != _SEGMENT_MAGIC
+            ):
+                return None
+            length, crc = _FRAME.unpack_from(head, len(_SEGMENT_MAGIC))
+            payload = f.read(length)
+    except OSError:
+        return None
+    if len(payload) != length or zlib.crc32(payload) != crc:
+        return None
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except ValueError:
+        return None
+
+
+class WalTailer:
+    """Live follower of a ``WalWriter``'s directory from another process.
+
+    Torn-tail discipline — an abandoned ``os.write`` leaves a frame
+    *prefix*, never a full-length frame with a bad CRC, so a short frame
+    is disambiguated by segment position:
+
+    - short frame at the tail of the NEWEST segment: the writer is
+      mid-append (or dead mid-append); hold position and retry next poll.
+    - short frame with a newer segment already on disk: a crash artifact
+      that will never complete; re-read once (the bytes are final), then
+      skip to the next segment — same loss semantics ``read_records``
+      gives the primary on restart.
+    - full-length frame failing CRC/JSON, or a complete segment with bad
+      magic: real corruption → ``WalTailCorruption`` (owner re-bootstraps).
+
+    Registration: the tailer drops ``tail-<id>.ack`` (atomic
+    ``os.replace``) recording the highest segment it has fully consumed;
+    ``WalWriter.barrier()`` retains anything past that floor. ``close()``
+    withdraws the registration."""
+
+    def __init__(self, directory: str, tailer_id: str):
+        self.directory = directory
+        self.tailer_id = tailer_id
+        self._ack_path = os.path.join(directory, _ACK_FMT % tailer_id)
+        self._seq: int | None = None  # segment currently being read
+        self._pos = 0  # byte offset of the next unread frame
+        self.frames_read = 0
+        self.segments_finished = 0
+        self.partial_retries = 0
+        self._ack(-1)  # register before reading: pins retention from t0
+
+    def _ack(self, seq: int) -> None:
+        tmp = self._ack_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"seq": seq, "id": self.tailer_id}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ack_path)
+
+    def _segments_from(self, seq: int | None) -> list[tuple[int, str]]:
+        segs = list_segments(self.directory)
+        if seq is None:
+            return segs
+        return [(s, p) for s, p in segs if s >= seq]
+
+    def seek_to_segment(self, seq: int) -> None:
+        """Position at the start of segment ``seq`` (bootstrap entry:
+        the caller read a barrier snapshot and tails everything after)."""
+        self._seq = seq
+        self._pos = 0
+        if seq > 0:
+            self._ack(seq - 1)
+
+    def poll(self, max_records: int | None = None) -> list[dict]:
+        """Return every newly completed record since the last poll (empty
+        when the writer is idle or mid-append). Raises
+        ``WalTailCorruption`` / ``WalSegmentGone`` per the class
+        docstring."""
+        out: list[dict] = []
+        while max_records is None or len(out) < max_records:
+            if self._seq is None:
+                segs = self._segments_from(None)
+                if not segs:
+                    break
+                self._seq, self._pos = segs[0][0], 0
+            path = os.path.join(self.directory, _SEGMENT_FMT % self._seq)
+            # list for newer segments BEFORE reading: only a rotation
+            # witnessed before the read makes the bytes final, so a torn
+            # frame in them is an authoritative tear. Listing after would
+            # race a live writer that completes the frame and rotates
+            # between the read and the listing — and drop good frames.
+            later = self._segments_from(self._seq + 1)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                later = self._segments_from(self._seq + 1)
+                if later and self._pos == 0:
+                    # never started this segment; a barrier pruned it while
+                    # we were idle at its boundary — resume at the next one
+                    self._seq, self._pos = later[0][0], 0
+                    continue
+                if later:
+                    raise WalSegmentGone(
+                        f"segment {self._seq} pruned mid-read at {self._pos}"
+                    )
+                break  # directory empty/young: nothing to read yet
+            n, complete = self._scan(data, later_exists=bool(later), out=out)
+            if not complete:
+                break  # holding at a live tail
+            # segment exhausted (tear skipped or cleanly done): advance
+            self.segments_finished += 1
+            self._ack(self._seq)
+            self._seq = later[0][0] if later else self._seq + 1
+            self._pos = 0
+            if not later:
+                break  # next segment not on disk yet
+        return out
+
+    def _scan(self, data: bytes, later_exists: bool, out: list[dict]) -> tuple[int, bool]:
+        """Consume complete frames from ``data`` starting at ``self._pos``
+        into ``out``. Returns ``(frames, segment_complete)`` where
+        ``segment_complete`` means the tailer is done with this segment
+        (fully parsed, or its tear is authoritative and skipped)."""
+        if self._pos == 0:
+            if len(data) < len(_SEGMENT_MAGIC):
+                if later_exists:
+                    return 0, True  # magic never completed; crash artifact
+                return 0, False
+            if data[: len(_SEGMENT_MAGIC)] != _SEGMENT_MAGIC:
+                raise WalTailCorruption(
+                    f"segment {self._seq}: bad magic {data[:6]!r}"
+                )
+            self._pos = len(_SEGMENT_MAGIC)
+        frames = 0
+        while self._pos < len(data):
+            if self._pos + _FRAME.size > len(data):
+                break  # short header
+            length, crc = _FRAME.unpack_from(data, self._pos)
+            start = self._pos + _FRAME.size
+            payload = data[start : start + length]
+            if len(payload) != length:
+                break  # short payload
+            if zlib.crc32(payload) != crc:
+                raise WalTailCorruption(
+                    f"segment {self._seq} @ {self._pos}: CRC mismatch"
+                )
+            try:
+                rec = json.loads(payload.decode("utf-8"))
+            except ValueError as e:
+                raise WalTailCorruption(
+                    f"segment {self._seq} @ {self._pos}: bad JSON ({e})"
+                ) from None
+            out.append(rec)
+            self._pos = start + length
+            frames += 1
+            self.frames_read += 1
+        if self._pos >= len(data):
+            return frames, later_exists  # fully parsed; done iff rotated away
+        if later_exists:
+            # frame prefix that can never complete: authoritative tear.
+            # Count it and abandon the remainder of this segment.
+            self.partial_retries += 1
+            return frames, True
+        return frames, False  # live tail: the writer may still finish it
+
+    def stats(self) -> dict:
+        return {
+            "segment_seq": self._seq,
+            "position": self._pos,
+            "frames_read": self.frames_read,
+            "segments_finished": self.segments_finished,
+            "partial_retries": self.partial_retries,
+        }
+
+    def close(self) -> None:
+        try:
+            os.unlink(self._ack_path)
+        except OSError:
+            pass

@@ -14,19 +14,41 @@ into the worker loop.
 """
 
 from skyline_tpu.serve.admission import AdmissionController, QueryGate, TokenBucket
-from skyline_tpu.serve.deltas import DeltaRing, snapshot_delta
+from skyline_tpu.serve.deltas import (
+    DeltaRing,
+    apply_delta_record,
+    delta_wal_record,
+    snapshot_delta,
+    snapshot_wal_record,
+)
 from skyline_tpu.serve.server import QueryBridge, ServeConfig, SkylineServer
 from skyline_tpu.serve.snapshot import Snapshot, SnapshotStore
+
+
+def __getattr__(name):
+    # replica pulls in the resilience plane; load it lazily so plain serve
+    # users don't pay for (or depend on) the WAL machinery
+    if name in ("SkylineReplica", "ReplicaDivergence", "run_replica"):
+        from skyline_tpu.serve import replica as _replica
+
+        return getattr(_replica, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "AdmissionController",
     "DeltaRing",
     "QueryBridge",
     "QueryGate",
+    "ReplicaDivergence",
     "ServeConfig",
-    "SkylineServer",
+    "SkylineReplica",
     "Snapshot",
     "SnapshotStore",
     "TokenBucket",
+    "apply_delta_record",
+    "delta_wal_record",
+    "run_replica",
     "snapshot_delta",
+    "snapshot_wal_record",
 ]

@@ -56,6 +56,123 @@ def snapshot_delta(old_points, new_points):
     return entered, left
 
 
+def order_permutation(candidate: np.ndarray, target: np.ndarray):
+    """Indices ``perm`` with ``candidate[perm]`` byte-equal to ``target``,
+    or None when the row multisets differ. Duplicate rows are matched
+    positionally (first unclaimed candidate slot wins) — any assignment of
+    equal rows is byte-equivalent."""
+    if candidate.shape != target.shape:
+        return None
+    from collections import defaultdict, deque as _deque
+
+    slots: dict[bytes, _deque] = defaultdict(_deque)
+    for i, k in enumerate(_row_keys(candidate)):
+        slots[k.tobytes()].append(i)
+    perm = np.empty(target.shape[0], dtype=np.int64)
+    for j, k in enumerate(_row_keys(target)):
+        q = slots.get(k.tobytes())
+        if not q:
+            return None
+        perm[j] = q.popleft()
+    return perm
+
+
+def apply_delta_record(points: np.ndarray, rec: dict) -> np.ndarray:
+    """Fold one WAL ``delta`` record into ``points``, reproducing the
+    primary's snapshot bytes exactly when the record carries ordering info
+    (``rows`` full override, or ``perm`` over [kept-in-prev-order,
+    entered]); set-exact otherwise — the pre-replication WAL format."""
+    from skyline_tpu.resilience.wal import rows_from_b64
+
+    d = int(rec["d"])
+    if "rows" in rec:  # perm construction failed on the primary: full copy
+        return rows_from_b64(rec["rows"], d)
+    entered = rows_from_b64(rec["entered"], d)
+    left = rows_from_b64(rec["left"], d)
+    kept = points
+    if left.shape[0] and points.shape[0]:
+        kept = points[~np.isin(_row_keys(points), _row_keys(left))]
+    if entered.shape[0]:
+        new = np.concatenate([kept, entered]) if kept.shape[0] else entered
+    else:
+        new = kept
+    if "perm" in rec:
+        new = new[np.asarray(rec["perm"], dtype=np.int64)]
+    return np.ascontiguousarray(new, dtype=np.float32)
+
+
+def delta_wal_record(prev, snap) -> dict:
+    """Build the WAL ``delta`` record for one publish transition.
+
+    Inverse of :func:`apply_delta_record`: besides the (entered, left) set
+    difference it carries the ordering info (``perm`` over
+    [kept-in-prev-order, entered], or full ``rows`` when the multisets defy
+    a permutation) so a WAL follower reproduces the snapshot BYTES, not
+    just the set. Shared by the worker's publish hook, the replica bench
+    leg, and the replica tests — one encoder, one decoder.
+    """
+    from skyline_tpu.resilience.wal import rows_to_b64
+
+    entered, left = snapshot_delta(
+        prev.points
+        if prev is not None
+        else np.empty((0, snap.points.shape[1]), dtype=np.float32),
+        snap.points,
+    )
+    rec = {
+        "type": "delta",
+        "from": prev.version if prev is not None else 0,
+        "to": snap.version,
+        "wm": snap.watermark_id,
+        "ts": snap.timestamp_ms,
+        "d": int(snap.points.shape[1]),
+        "entered": rows_to_b64(entered),
+        "left": rows_to_b64(left),
+        "digest": snap.digest,
+    }
+    if snap.event_wm_ms is not None:
+        rec["ewm"] = snap.event_wm_ms  # freshness lineage survives restart
+    if snap.meta:
+        rec["meta"] = snap.meta  # partial/excluded_chips survive the tail
+    kept = (
+        prev.points
+        if prev is not None and not left.shape[0]
+        else (
+            prev.points[~np.isin(_row_keys(prev.points), _row_keys(left))]
+            if prev is not None and prev.points.shape[0]
+            else np.empty((0, snap.points.shape[1]), dtype=np.float32)
+        )
+    )
+    candidate = np.concatenate([kept, entered]) if kept.shape[0] else entered
+    perm = order_permutation(candidate, snap.points)
+    if perm is None:
+        rec["rows"] = rows_to_b64(snap.points)
+    elif not np.array_equal(perm, np.arange(perm.shape[0])):
+        rec["perm"] = perm.tolist()
+    return rec
+
+
+def snapshot_wal_record(snap) -> dict:
+    """The ``snap`` block of a WAL ``ckpt`` barrier: the exact serve head
+    (bytes, lineage, and honesty meta) a bootstrap restores from."""
+    from skyline_tpu.resilience.wal import rows_to_b64
+
+    rec = {
+        "version": snap.version,
+        "watermark_id": snap.watermark_id,
+        "timestamp_ms": snap.timestamp_ms,
+        "d": int(snap.points.shape[1]),
+        "rows": rows_to_b64(snap.points),
+    }
+    if snap.event_wm_ms is not None:
+        rec["event_wm_ms"] = snap.event_wm_ms
+    if snap.meta:
+        # degraded heads (partial/excluded_chips) must survive a bootstrap
+        # honestly — never laundered clean by recovery
+        rec["meta"] = snap.meta
+    return rec
+
+
 class Delta:
     """One published transition: what changed going from_version -> to_version."""
 
@@ -108,6 +225,14 @@ class DeltaRing:
             self._ring.clear()
             self._ring.extend(deltas)
             self.head_version = max(int(head_version), 0)
+
+    def latest(self) -> Delta | None:
+        """Most recent transition (None when the ring is empty). The SSE
+        fanout reads this in the store's publish hook: the ring subscribes
+        to the store before the server does, so at callback time the tail
+        delta is the one for the snapshot just published."""
+        with self._lock:
+            return self._ring[-1] if self._ring else None
 
     @property
     def oldest_since(self) -> int | None:

@@ -15,8 +15,12 @@ operator observability; this one serves the skyline itself. Endpoints:
                   query plane) — admission-controlled, deadline-bounded.
   GET  /deltas    ``?since=<version>``: what entered/left the skyline
                   between that version and the head, from the bounded
-                  delta ring; 410 Gone once ``since`` fell behind the ring
-                  (re-baseline with GET /skyline).
+                  delta ring; 410 Gone + ``"resync": true`` once ``since``
+                  fell behind the ring (re-baseline with GET /skyline).
+  GET  /subscribe SSE push of published deltas (``event: delta`` per
+                  publish; ``event: resync`` when the subscriber must
+                  re-baseline — slow consumer or ring overrun).
+                  ``?since=V`` replays the net ring catch-up first.
   GET  /healthz   readiness probe.
   GET  /stats     worker + engine counters plus serve-plane counters.
   GET  /metrics   Prometheus text exposition (admission counters, snapshot
@@ -84,6 +88,8 @@ class ServeConfig:
         delta_ring: int = 128,
         history: int = 64,
         read_cache_entries: int = 64,
+        tenant_rate: float = 0.0,  # per-tenant read tokens/s; 0 disables
+        tenant_burst: int = 64,
     ):
         self.port = port
         self.host = host
@@ -95,6 +101,8 @@ class ServeConfig:
         self.delta_ring = delta_ring
         self.history = history
         self.read_cache_entries = read_cache_entries
+        self.tenant_rate = tenant_rate
+        self.tenant_burst = tenant_burst
 
     def admission(self, counters=None) -> AdmissionController:
         return AdmissionController(
@@ -104,6 +112,8 @@ class ServeConfig:
             max_query_queue=self.max_query_queue,
             query_deadline_ms=self.query_deadline_ms,
             counters=counters,
+            tenant_rate=self.tenant_rate,
+            tenant_burst=self.tenant_burst,
         )
 
 
@@ -200,12 +210,23 @@ class SkylineServer:
         host: str = "127.0.0.1",
         telemetry=None,
         read_cache: int = 64,
+        max_stale_ms: float | None = None,
+        role: str = "primary",
     ):
+        """``max_stale_ms``: the staleness fence — any ``/skyline`` read
+        whose snapshot is older than this (event-time watermark when
+        available, publish age otherwise) is refused with 503 +
+        Retry-After, regardless of ``allow_stale``. The replica plane's
+        honesty contract; None (primary default) disables. ``role`` rides
+        ``/healthz`` and fence rejections so probes can tell a replica
+        from the primary."""
         self.store = store
         self.deltas = deltas
         self.admission = admission if admission is not None else AdmissionController()
         self.stats_cb = stats_cb
         self.bridge = bridge
+        self.max_stale_ms = max_stale_ms
+        self.role = role
         # read-side result cache: serialized response bodies keyed by
         # (snapshot version, format/projection) — snapshots are immutable,
         # so repeated reads of the same version skip re-serialization (the
@@ -231,6 +252,15 @@ class SkylineServer:
         self._header_timeout_s = env_float(
             "SKYLINE_SERVE_HEADER_TIMEOUT_S", 10.0
         )
+        from skyline_tpu.analysis.registry import env_int
+
+        # SSE push (GET /subscribe): per-subscriber bounded queues fed from
+        # the store's publish hook. Overflow (a slow consumer) clears the
+        # queue and enqueues a resync marker — the stream never silently
+        # drops a delta without telling the subscriber to re-baseline.
+        self._sse_queue_cap = max(1, env_int("SKYLINE_SERVE_SSE_QUEUE", 64))
+        self._sse_queues: set = set()  # mutated on the loop thread only
+        self._sse_events = 0
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._startup_error: BaseException | None = None
@@ -243,6 +273,9 @@ class SkylineServer:
         ready.wait(timeout=self._ready_timeout_s)
         if self._startup_error is not None:
             raise self._startup_error
+        # subscribe only once the loop is live (never on a failed startup):
+        # the hook bounces publish events onto the loop thread for SSE fanout
+        store.on_publish(self._sse_on_publish)
 
     def _run(self, host, port, ready):
         asyncio.set_event_loop(self._loop)
@@ -261,13 +294,146 @@ class SkylineServer:
         finally:
             self._server.close()
             self._loop.run_until_complete(self._server.wait_closed())
+            # long-lived /subscribe streams outlive run_forever: cancel and
+            # reap them so loop.close() never destroys a pending task
+            pending = [
+                t for t in asyncio.all_tasks(self._loop) if not t.done()
+            ]
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
             self._loop.close()
 
     def close(self) -> None:
         if self._startup_error is not None:
             return
+        self._loop.call_soon_threadsafe(self._sse_shutdown)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=self._shutdown_timeout_s)
+
+    # -- SSE push (/subscribe) ---------------------------------------------
+
+    def _sse_on_publish(self, prev, snap) -> None:
+        """Store publish hook (engine thread): shape one SSE event and hand
+        it to the loop thread. The ring subscribed before this server, so
+        its tail delta is the one for ``snap``."""
+        if self._startup_error is not None or not self._sse_queues:
+            return
+        tail = self.deltas.latest() if self.deltas is not None else None
+        if tail is not None and tail.to_version == snap.version:
+            event = (
+                "delta",
+                {
+                    "from_version": tail.from_version,
+                    "to_version": tail.to_version,
+                    "watermark_id": snap.watermark_id,
+                    "entered": tail.entered.tolist(),
+                    "left": tail.left.tolist(),
+                    "meta": snap.meta,
+                },
+            )
+        else:  # no ring: announce the version; subscribers re-read
+            event = ("resync", {"head_version": snap.version})
+        try:
+            self._loop.call_soon_threadsafe(self._sse_fanout, event)
+        except RuntimeError:  # loop already closed (shutdown race)
+            pass
+
+    def _sse_fanout(self, event) -> None:
+        """Loop thread: enqueue to every subscriber; a full queue (slow
+        consumer) is cleared and handed an explicit resync marker instead
+        of silently dropping deltas."""
+        self._sse_events += 1
+        for q in list(self._sse_queues):
+            if q.full():
+                while not q.empty():
+                    q.get_nowait()
+                q.put_nowait(
+                    (
+                        "resync",
+                        {
+                            "head_version": self.store.head_version,
+                            "reason": "subscriber fell behind",
+                        },
+                    )
+                )
+            else:
+                q.put_nowait(event)
+
+    def _sse_shutdown(self) -> None:
+        for q in list(self._sse_queues):
+            if q.full():
+                while not q.empty():
+                    q.get_nowait()
+            q.put_nowait(None)  # sentinel: stream handlers exit cleanly
+
+    async def _subscribe(self, writer, params):
+        """SSE stream of published deltas. ``?since=V`` replays the net
+        catch-up from the ring first; a ``since`` behind the ring (or no
+        ring) opens with an explicit ``resync`` event — same contract as
+        the 410 on ``/deltas``."""
+        try:
+            since = _int_param(params, "since")
+        except ValueError as e:
+            await self._reply(writer, 400, {"error": str(e)})
+            return
+        head = [
+            "HTTP/1.1 200 OK",
+            "Content-Type: text/event-stream",
+            "Cache-Control: no-cache",
+            "Connection: close",
+        ]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        q: asyncio.Queue = asyncio.Queue(maxsize=self._sse_queue_cap)
+        self._sse_queues.add(q)
+        try:
+            if since is not None:
+                res = self.deltas.since(since) if self.deltas is not None else None
+                if res is None:
+                    await self._sse_write(
+                        writer,
+                        "resync",
+                        {
+                            "since": since,
+                            "head_version": self.store.head_version,
+                            "hint": "re-baseline with GET /skyline",
+                        },
+                    )
+                else:
+                    entered, left, hv = res
+                    await self._sse_write(
+                        writer,
+                        "delta",
+                        {
+                            "from_version": since,
+                            "to_version": hv,
+                            "entered": entered.tolist(),
+                            "left": left.tolist(),
+                        },
+                    )
+            while True:
+                try:
+                    item = await asyncio.wait_for(q.get(), timeout=15.0)
+                except asyncio.TimeoutError:
+                    writer.write(b": keepalive\n\n")
+                    await writer.drain()
+                    continue
+                if item is None:
+                    break
+                await self._sse_write(writer, item[0], item[1])
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._sse_queues.discard(q)
+
+    async def _sse_write(self, writer, kind: str, doc: dict) -> None:
+        writer.write(
+            f"event: {kind}\ndata: {json.dumps(doc)}\n\n".encode()
+        )
+        await writer.drain()
 
     # -- request plumbing --------------------------------------------------
 
@@ -306,7 +472,7 @@ class SkylineServer:
                 await reader.readexactly(clen)  # body currently unused
             url = urlsplit(target)
             params = {k: v[-1] for k, v in parse_qs(url.query).items()}
-            await self._route(writer, method, url.path, params)
+            await self._route(writer, method, url.path, params, headers)
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -315,12 +481,17 @@ class SkylineServer:
             except Exception:
                 pass
 
-    async def _route(self, writer, method, path, params):
+    async def _route(self, writer, method, path, params, headers=None):
+        tenant = (headers or {}).get("x-tenant")
         if path == "/healthz":
             await self._reply(
                 writer,
                 200,
-                {"ok": True, "published": self.store.published > 0},
+                {
+                    "ok": True,
+                    "published": self.store.published > 0,
+                    "role": self.role,
+                },
             )
         elif path == "/stats" and method == "GET":
             await self._reply(writer, 200, self._stats())
@@ -330,16 +501,18 @@ class SkylineServer:
             await self._reply(writer, 200, self.telemetry.spans.to_chrome())
         elif path == "/skyline" and method == "GET":
             t0 = time.perf_counter_ns()
-            await self._skyline(writer, params)
+            await self._skyline(writer, params, tenant=tenant)
             self.telemetry.histogram("serve_read_ms").observe(
                 (time.perf_counter_ns() - t0) / 1e6
             )
         elif path == "/deltas" and method == "GET":
             t0 = time.perf_counter_ns()
-            await self._deltas(writer, params)
+            await self._deltas(writer, params, tenant=tenant)
             self.telemetry.histogram("serve_read_ms").observe(
                 (time.perf_counter_ns() - t0) / 1e6
             )
+        elif path == "/subscribe" and method == "GET":
+            await self._subscribe(writer, params)
         elif path == "/query" and method == "POST":
             await self._query(writer)
         elif path == "/profile" and method == "GET":
@@ -365,6 +538,11 @@ class SkylineServer:
         except Exception as e:  # observability must not 500 the plane down
             out = {"stats_error": str(e)}
         out["serve"] = self.admission.stats()
+        out["serve"]["role"] = self.role
+        out["serve"]["sse_subscribers"] = len(self._sse_queues)
+        out["serve"]["sse_events"] = self._sse_events
+        if self.max_stale_ms is not None:
+            out["serve"]["max_stale_ms"] = self.max_stale_ms
         out["snapshot_store"] = self.store.stats()
         if self.deltas is not None:
             out["delta_ring"] = self.deltas.stats()
@@ -402,17 +580,35 @@ class SkylineServer:
         if self.bridge is not None:
             gauges["serve_bridge_depth"] = float(self.bridge.depth)
         gauges["serve_query_depth"] = float(self.admission.queries.depth)
+        gauges["serve_sse_subscribers"] = float(len(self._sse_queues))
         counters = {
             f"serve_{k}": v
             for k, v in self.admission.counters.snapshot().items()
         }
+        # per-tenant admission series: one labeled family per outcome, so
+        # dashboards see exactly who is being shed
+        tenants = self.admission.tenant_stats()
+        labeled = None
+        if tenants:
+            labeled = {
+                "serve_tenant_reads_admitted": [
+                    ((("tenant", t),), row["admitted"])
+                    for t, row in tenants.items()
+                ],
+                "serve_tenant_reads_shed": [
+                    ((("tenant", t),), row["shed"])
+                    for t, row in tenants.items()
+                ],
+            }
         body = self.telemetry.render_prometheus(
-            gauges=gauges, extra_counters=counters
+            gauges=gauges,
+            extra_counters=counters,
+            extra_labeled_counters=labeled,
         ).encode()
         await self._reply_raw(writer, 200, body, PROMETHEUS_CONTENT_TYPE)
 
-    async def _skyline(self, writer, params):
-        ok, retry = self.admission.admit_read()
+    async def _skyline(self, writer, params, tenant=None):
+        ok, retry = self.admission.admit_read(tenant=tenant)
         if not ok:
             await self._reply(
                 writer,
@@ -431,6 +627,30 @@ class SkylineServer:
         if rs is None:
             await self._reply(
                 writer, 503, {"error": "no snapshot published yet"}
+            )
+            return
+        if (
+            self.max_stale_ms is not None
+            and rs.staleness_ms is not None
+            and rs.staleness_ms > self.max_stale_ms
+        ):
+            # the staleness fence: a replica that fell too far behind the
+            # WAL refuses to answer rather than serve silently ancient
+            # data — allow_stale bounds the CLIENT's tolerance, never the
+            # server's own honesty contract
+            self.admission.counters.inc("fence_rejected")
+            await self._reply(
+                writer,
+                503,
+                {
+                    "error": "staleness fence exceeded",
+                    "role": self.role,
+                    "version": rs.snapshot.version,
+                    "staleness_ms": round(rs.staleness_ms, 1),
+                    "max_stale_ms": self.max_stale_ms,
+                    "stale": True,
+                },
+                retry_after=1.0,
             )
             return
         refresh_triggered = False
@@ -476,6 +696,7 @@ class SkylineServer:
                     "X-Skyline-Version": str(snap.version),
                     "X-Skyline-Digest": snap.digest,
                     "X-Skyline-Size": str(snap.size),
+                    "X-Skyline-Staleness-Ms": str(round(rs.staleness_ms, 1)),
                 },
             )
             return
@@ -597,8 +818,8 @@ class SkylineServer:
         doc["enabled"] = True
         await self._reply(writer, 200, doc)
 
-    async def _deltas(self, writer, params):
-        ok, retry = self.admission.admit_read()
+    async def _deltas(self, writer, params, tenant=None):
+        ok, retry = self.admission.admit_read(tenant=tenant)
         if not ok:
             await self._reply(
                 writer,
@@ -626,24 +847,35 @@ class SkylineServer:
                 410,
                 {
                     "error": "version fell behind the delta ring",
+                    # explicit machine-readable marker: a catch-up past the
+                    # ring MUST re-baseline from a full snapshot — never
+                    # interpret this body as an empty/partial delta list
+                    "resync": True,
                     "since": since,
                     "oldest_since": self.deltas.oldest_since,
+                    "head_version": self.deltas.head_version,
                     "hint": "re-baseline with GET /skyline",
                 },
             )
             return
         entered, left, head = res
         self.admission.counters.inc("deltas_served")
+        rs = self.store.read()
         await self._reply(
             writer,
             200,
             {
                 "from_version": since,
                 "to_version": head,
+                "resync": False,
                 "count_entered": int(entered.shape[0]),
                 "count_left": int(left.shape[0]),
                 "entered": entered.tolist(),
                 "left": left.tolist(),
+                # the freshness watermark rides every read surface
+                "staleness_ms": (
+                    round(rs.staleness_ms, 1) if rs is not None else None
+                ),
             },
         )
 

@@ -127,6 +127,7 @@ class Telemetry:
         gauges: dict[str, float] | None = None,
         extra_counters: dict[str, float] | None = None,
         prefix: str = "skyline",
+        extra_labeled_counters: dict | None = None,
     ) -> str:
         counters = dict(self.counters.snapshot())
         # span-ring overwrites are silent data loss for /trace readers;
@@ -150,6 +151,12 @@ class Telemetry:
         labeled_counters = labeled_gauges = None
         if self.fleet is not None:
             labeled_counters, labeled_gauges = self.fleet.labeled_series()
+        if extra_labeled_counters:
+            # per-tenant admission series from the serve plane ride along
+            # the fleet's per-chip families
+            labeled_counters = {
+                **(labeled_counters or {}), **extra_labeled_counters
+            }
         return render_prometheus(
             counters=counters,
             gauges=gauges,

@@ -70,6 +70,12 @@ DEFAULT_RULES = (
     # correctness regression outright — no baseline, no threshold
     {"label": "audit.divergence_total",
      "path": ["audit", "divergence_total"], "absolute": True},
+    # replica plane (ISSUE 15): WAL tail-to-serve lag creeping up means
+    # replicas are answering ever-staler reads; same noise floor caveat
+    # as freshness on the CPU fallback, so only a blowup trips
+    {"label": "replica.read_lag_p99_ms",
+     "path": ["replica", "read_lag_p99_ms"], "higher_is_better": False,
+     "threshold": 2.0},
 )
 
 

@@ -1,7 +1,7 @@
 """Declarative SLOs with multi-window burn-rate evaluation (``GET /slo``).
 
-Six objectives, each a row in a declarative table (targets are knobs, see
-RUNBOOK §2j):
+Seven objectives, each a row in a declarative table (targets are knobs,
+see RUNBOOK §2j):
 
 - ``read_p99``       — 99% of /skyline reads complete under
                        ``SKYLINE_SLO_READ_P99_MS`` (error budget 1%).
@@ -19,6 +19,11 @@ RUNBOOK §2j):
                        answered queries publish chip-degraded (marked
                        ``partial``, RUNBOOK §2p) — the availability the
                        failover layer is accountable for.
+- ``tenant_shed_fraction`` — at most ``SKYLINE_SLO_TENANT_SHED`` of
+                       tenant-attributed read attempts are shed by the
+                       per-tenant buckets (RUNBOOK §2q); ``evaluate()``
+                       also carries a cumulative per-tenant breakdown so
+                       the burning tenant is identifiable.
 
 Evaluation is the standard SRE multi-window scheme: each ``evaluate()``
 samples the cumulative counters, appends them to a bounded ring, and diffs
@@ -88,6 +93,9 @@ class SloEngine:
                 "fraction",
                 env_float("SKYLINE_SLO_DEGRADED_ANSWERS", 0.01),
             ),
+            "tenant_shed_fraction": (
+                "fraction", env_float("SKYLINE_SLO_TENANT_SHED", 0.05),
+            ),
         }
         self._admission = None  # serve-plane counters (reads_served/shed)
         self._lock = threading.Lock()
@@ -127,6 +135,12 @@ class SloEngine:
         answered = int(tel.counters.get("queries.answered"))
         degraded = int(tel.counters.get("degraded_answers"))
         out["degraded_answers"] = (answered, degraded)
+        t_total = t_shed = 0
+        if self._admission is not None:
+            for row in self._admission.tenant_stats().values():
+                t_total += int(row["admitted"]) + int(row["shed"])
+                t_shed += int(row["shed"])
+        out["tenant_shed_fraction"] = (t_total, t_shed)
         return out
 
     def _window(self, samples, now_s: float, window_s: float, name: str):
@@ -201,10 +215,29 @@ class SloEngine:
                 "windows": windows,
                 "breach": breach,
             }
-        return {
+        doc = {
             "ok": not any_breach,
             "evaluated_at_s": round(now, 3),
             "fast_window_s": self.fast_window_s,
             "slow_window_s": self.slow_window_s,
             "slos": slos,
         }
+        # cumulative per-tenant breakdown so a burning tenant_shed_fraction
+        # row points at WHICH tenant is over budget (not burn-rate math —
+        # the aggregate row owns the windows; this is attribution)
+        if self._admission is not None:
+            tenants = self._admission.tenant_stats()
+            if tenants:
+                doc["tenants"] = {
+                    t: {
+                        "admitted": row["admitted"],
+                        "shed": row["shed"],
+                        "shed_fraction": round(
+                            row["shed"]
+                            / max(1, row["admitted"] + row["shed"]),
+                            6,
+                        ),
+                    }
+                    for t, row in tenants.items()
+                }
+        return doc

@@ -67,6 +67,17 @@ class JobConfig:
     serve_delta_ring: int = 128  # retained snapshot transitions
     serve_history: int = 64  # retained snapshot versions
     serve_read_cache: int = 64  # serialized-response LRU entries (0 = off)
+    # per-tenant admission (X-Tenant header -> per-tenant token bucket);
+    # 0 = the global bucket only
+    serve_tenant_rate: float = 0.0
+    serve_tenant_burst: int = 64
+    # read replication (skyline_tpu/serve/replica): --replicas N spawns N
+    # in-process WAL-tailing read replicas beside the engine (requires
+    # --checkpoint-dir and --serve); --replica-of <wal_dir> turns this
+    # process into a standalone read replica of that WAL instead of an
+    # engine worker
+    replicas: int = 0
+    replica_of: str = ""
     # observability (skyline_tpu/telemetry): Chrome trace-event export of
     # the per-query span ring, and opt-in device profiling of forced merges
     trace_out: str = ""  # write span ring as Chrome trace JSON on close
@@ -163,6 +174,29 @@ class JobConfig:
         if self.trace_ring < 1:
             raise ValueError(
                 f"trace_ring must be >= 1, got {self.trace_ring}"
+            )
+        if self.serve_tenant_rate < 0:
+            raise ValueError(
+                f"serve_tenant_rate must be >= 0, got {self.serve_tenant_rate}"
+            )
+        if self.serve_tenant_burst < 1:
+            raise ValueError(
+                f"serve_tenant_burst must be >= 1, got {self.serve_tenant_burst}"
+            )
+        if self.replicas < 0:
+            raise ValueError(f"replicas must be >= 0, got {self.replicas}")
+        if self.replicas and not self.checkpoint_dir:
+            # replicas bootstrap from and tail the WAL; without a
+            # checkpoint dir there is no WAL to tail
+            raise ValueError("--replicas requires --checkpoint-dir")
+        if self.replicas and self.serve_port < 0:
+            raise ValueError(
+                "--replicas requires the serve plane (--serve >= 0): "
+                "replicas mirror published snapshots"
+            )
+        if self.replica_of and self.replicas:
+            raise ValueError(
+                "--replica-of and --replicas are mutually exclusive"
             )
         # the over-partitioning factor is owned by EngineConfig; validate
         # against it rather than a duplicated literal
@@ -261,6 +295,8 @@ class JobConfig:
             delta_ring=self.serve_delta_ring,
             history=self.serve_history,
             read_cache_entries=self.serve_read_cache,
+            tenant_rate=self.serve_tenant_rate,
+            tenant_burst=self.serve_tenant_burst,
         )
 
     def resilience_config(self):
@@ -420,6 +456,25 @@ def parse_job_args(argv=None) -> JobConfig:
                     default=env_int("SKYLINE_SERVE_READ_CACHE",
                                     defaults.serve_read_cache),
                     help="serialized-response LRU entries (0 disables)")
+    ap.add_argument("--serve-tenant-rate", type=float,
+                    default=env_float("SKYLINE_SERVE_TENANT_RATE",
+                                      defaults.serve_tenant_rate),
+                    help="per-tenant snapshot-read token rate per second "
+                         "(X-Tenant header; 0 disables the tenant plane)")
+    ap.add_argument("--serve-tenant-burst", type=int,
+                    default=env_int("SKYLINE_SERVE_TENANT_BURST",
+                                    defaults.serve_tenant_burst),
+                    help="per-tenant token bucket capacity")
+    ap.add_argument("--replicas", type=int,
+                    default=env_int("SKYLINE_REPLICAS", defaults.replicas),
+                    help="spawn this many in-process WAL-tailing read "
+                         "replicas beside the engine (requires "
+                         "--checkpoint-dir and --serve)")
+    ap.add_argument("--replica-of",
+                    default=env_str("SKYLINE_REPLICA_OF",
+                                    defaults.replica_of),
+                    help="run as a standalone read replica tailing this "
+                         "WAL directory instead of an engine worker")
     ap.add_argument("--trace-out",
                     default=env_str("SKYLINE_TRACE_OUT",
                                     defaults.trace_out),
@@ -493,6 +548,10 @@ def parse_job_args(argv=None) -> JobConfig:
         serve_delta_ring=a.serve_delta_ring,
         serve_history=a.serve_history,
         serve_read_cache=a.serve_read_cache,
+        serve_tenant_rate=a.serve_tenant_rate,
+        serve_tenant_burst=a.serve_tenant_burst,
+        replicas=a.replicas,
+        replica_of=a.replica_of,
         trace_out=a.trace_out,
         trace_ring=a.trace_ring,
         jax_profile_dir=a.jax_profile_dir,
