@@ -620,6 +620,116 @@ def replica_leg(d: int) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def cluster_leg(d: int) -> dict:
+    """Cluster-plane stamp (ISSUE 16): the two numbers the gates watch.
+
+    A skewed host-prune probe (host 0 owns an origin cluster, every other
+    host only dominated upper-region rows) exercises the host-witness
+    prefilter of the three-level tournament so the
+    ``cluster.host_pruned_fraction`` that ``scripts/bench_compare.py``
+    gates on is non-trivial — byte identity against a flat single-host
+    merge is asserted before the number is recorded. A promotion drill
+    (lease-holding primary publishes through a ``FencedWalWriter`` and
+    goes dark; the supervisor fences + promotes the caught-up replica)
+    records ``time_to_promote_ms``, which the telemetry sentinel watches
+    for stalls; the identity-asserting latency A/B lives in
+    ``benchmarks/cluster.py`` (artifacts/cluster_ab.json)."""
+    import shutil
+    import tempfile
+
+    from skyline_tpu.cluster import (
+        ClusterPartitionSet,
+        ClusterSupervisor,
+        FencedWalWriter,
+        LeasePlane,
+        WalFencedError,
+    )
+    from skyline_tpu.serve import SnapshotStore, delta_wal_record
+    from skyline_tpu.serve.replica import SkylineReplica
+    from skyline_tpu.serve.snapshot import points_digest
+    from skyline_tpu.stream.batched import PartitionSet
+
+    # prune probe: same geometry as sharded_leg's, one level up — host 0's
+    # witness dominates the other hosts' summaries outright
+    Pp, hosts = 8, 4
+    rng = np.random.default_rng(7)
+    lo = (rng.random((64, d)) * 40.0 + 1.0).astype(np.float32)
+    hi = (rng.random((256, d)) * 400.0 + 9000.0).astype(np.float32)
+    flat = PartitionSet(Pp, d, 4096)
+    cp = ClusterPartitionSet(Pp, d, 4096, hosts=hosts)
+    for pset in (flat, cp):
+        pset.add_batch(0, lo, max_id=1 << 20, now_ms=0.0)
+        for p in range(1, Pp):
+            pset.add_batch(p, hi, max_id=1 << 20, now_ms=0.0)
+        pset.flush_all()
+    ref = flat.global_merge_stats(emit_points=True)
+    res = cp.global_merge_stats(emit_points=True)
+    identical = bool(
+        res[2] == ref[2] and res[3].tobytes() == ref[3].tobytes()
+    )
+    cst = cp.cluster_stats()
+
+    # promotion drill: everything on an injected clock except the
+    # promotion wall itself (which is what the sentinel watches)
+    tmp = tempfile.mkdtemp(prefix="bench-cluster-")
+    writer = replica = None
+    try:
+        clock = {"now": 0.0}
+        plane = LeasePlane(tmp, clock=lambda: clock["now"])
+        lease = plane.acquire("primary-0", ttl_ms=500.0)
+        writer = FencedWalWriter(tmp, lease.epoch, plane=plane, fsync="off")
+        store = SnapshotStore()
+
+        def shadow(prev, snap):
+            writer.append(delta_wal_record(prev, snap))
+            writer.flush(force=True)
+
+        store.on_publish(shadow)
+        pts = rng.random((256, d)).astype(np.float32)
+        for i in range(1, 9):
+            store.publish(pts[: i * 32], watermark_id=i * 32)
+        replica = SkylineReplica(tmp, replica_id="r0", start=False)
+        replica.bootstrap()
+        while replica.apply_available():
+            pass
+        sup = ClusterSupervisor(
+            tmp, [replica], lease_ttl_ms=500.0, clock=lambda: clock["now"]
+        )
+        clock["now"] = 10_000.0  # primary dead: lease expired
+        doc = sup.tick()
+        promoted = doc is not None and doc["holder"] == "r0"
+        head_identical = bool(
+            promoted
+            and doc["head_digest"] == points_digest(store.latest().points)
+        )
+        try:
+            writer.append({"type": "delta", "probe": True})
+            deposed_rejected = False
+        except WalFencedError:
+            deposed_rejected = True
+        return {
+            "hosts": hosts,
+            "hosts_pruned": cst["hosts_pruned"],
+            "host_pruned_fraction": cst["host_pruned_fraction"],
+            "rows_shipped": cst["rows_shipped"],
+            "rows_saved": cst["rows_saved"],
+            "probe_identical": identical,
+            "promoted": promoted,
+            "time_to_promote_ms": (
+                doc["time_to_promote_ms"] if promoted else None
+            ),
+            "promoted_head_version": doc["head_version"] if promoted else None,
+            "promoted_head_identical": head_identical,
+            "deposed_append_rejected": deposed_rejected,
+        }
+    finally:
+        if replica is not None:
+            replica.close()
+        if writer is not None:
+            writer.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def child_main(backend: str) -> None:
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -740,6 +850,15 @@ def child_main(backend: str) -> None:
             replica = {"error": f"{type(e).__name__}: {e}"}
     else:
         replica = {"skipped": True}
+    # cluster-plane leg: host-prune probe + promotion drill
+    # (BENCH_CLUSTER=0 to skip)
+    if env_bool("BENCH_CLUSTER", True):
+        try:
+            cluster = cluster_leg(d)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            cluster = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        cluster = {"skipped": True}
     # lineage + kernel registry ride the artifact as top-level blocks so
     # scripts/bench_compare.py can gate on freshness.read_lag_p99_ms
     freshness = serve.pop("freshness", {"skipped": True})
@@ -818,6 +937,7 @@ def child_main(backend: str) -> None:
                 "rank_cascade": rank_cascade_stamp(),
                 "serve": serve,
                 "replica": replica,
+                "cluster": cluster,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,

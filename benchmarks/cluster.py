@@ -1,0 +1,338 @@
+"""A/B: single-host flat engine vs the cluster plane's three-level
+tournament merge at 1 vs N hosts, plus the promotion drill (ISSUE 16).
+
+For each (n, d) at P partitions, feeds IDENTICAL streams (same routing,
+same chunking, same flush cadence) to one flat ``PartitionSet`` and one
+``ClusterPartitionSet`` per host count, asserts the global merges
+byte-identical (rows AND order) BEFORE any timing, then times:
+
+- ``single_ms``:  flat single-host full merge (the baseline)
+- ``hosts_<H>_ms``: the three-level tournament at H hosts — per-host
+  members (sharded when ``--chips-per-host > 1``), host-witness
+  prefilter, cross-host pairwise merge
+
+The prune leg repeats the N-host measurement over a skewed stream (one
+host owns the origin cluster) so ``host_pruned_fraction`` is non-trivial
+— the number ``scripts/bench_compare.py`` gates on — and reports the
+interconnect rows a dominated host did NOT ship.
+
+The promotion leg measures time-to-promote: a lease-holding primary
+publishing through a ``FencedWalWriter`` goes dark, the supervisor's
+next tick fences it and promotes the most-caught-up WAL-tailing replica,
+and the promoted head's digest is asserted identical to the primary's
+last durable publish before the wall time is recorded.
+
+On CPU the hosts are processes-in-miniature over XLA host-platform
+virtual devices, so the interconnect win is not visible — the point here
+is identity + bookkeeping; a real multi-host run measures the actual
+cross-host traffic saved.
+
+Writes ``artifacts/cluster_ab.json``.
+
+Usage: python benchmarks/cluster.py [--repeats 5] [--hosts 2 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from skyline_tpu.analysis.registry import env_str  # noqa: E402
+
+
+def _timed(fn, repeats: int) -> float:
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1000.0)
+
+
+def _feed(pset, x: np.ndarray, P: int) -> None:
+    """Identical ingest for every engine under test: deterministic
+    round-robin routing, chunked adds, the engine's own flush cadence."""
+    n = x.shape[0]
+    pids = np.arange(n) % P
+    for lo in range(0, n, 4096):
+        hi = min(lo + 4096, n)
+        for p in range(P):
+            rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+            if rows.shape[0]:
+                pset.add_batch(p, rows, max_id=n, now_ms=0.0)
+        pset.maybe_flush()
+    pset.flush_all()
+
+
+def _stream(n: int, d: int, P: int, skew: bool) -> np.ndarray:
+    rng = np.random.default_rng(3)
+    if not skew:
+        from skyline_tpu.workload.generators import anti_correlated
+
+        return anti_correlated(rng, n, d, 0, 10000).astype(np.float32)
+    # skewed: partition 0's rows (host 0) cluster near the origin, the
+    # rest live in the dominated upper region — the host-prune prefilter's
+    # best case
+    x = (rng.random((n, d)) * 4000.0 + 5500.0).astype(np.float32)
+    x[::P] = (rng.random((len(x[::P]), d)) * 400.0 + 100.0).astype(
+        np.float32
+    )
+    return x
+
+
+def _dirty_round(pset, P: int, d: int, n: int):
+    # repeated merges over unchanged state would hit the epoch cache and
+    # time nothing; dirty one partition so every timed merge is a real
+    # full pass, identically on both sides
+    rng = np.random.default_rng(4)
+
+    def one():
+        pset.add_batch(
+            P - 1,
+            (rng.random((64, d)) * 400.0 + 9000.0).astype(np.float32),
+            max_id=n,
+            now_ms=0.0,
+        )
+        pset.flush_all()
+        pset.global_merge_stats(emit_points=True)
+
+    return one
+
+
+def bench_one(n: int, d: int, P: int, hosts_list: list[int],
+              chips_per_host: int, repeats: int) -> dict:
+    from skyline_tpu.cluster import ClusterPartitionSet
+    from skyline_tpu.stream.batched import PartitionSet
+
+    x = _stream(n, d, P, skew=False)
+    single = PartitionSet(P, d, buffer_size=max(n, 1024))
+    _feed(single, x, P)
+    ref = single.global_merge_stats(emit_points=True)  # warm + reference
+    single_ms = _timed(_dirty_round(single, P, d, n), repeats)
+
+    row = {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "chips_per_host": chips_per_host,
+        "skyline_size": int(ref[2]),
+        "single_ms": round(single_ms, 2),
+        "hosts": {},
+    }
+    for hosts in hosts_list:
+        cp = ClusterPartitionSet(
+            P, d, max(n, 1024), hosts=hosts, chips_per_host=chips_per_host
+        )
+        _feed(cp, x, P)
+        res = cp.global_merge_stats(emit_points=True)  # warm
+        # byte-identity BEFORE timing: a fast wrong answer is worthless
+        assert res[2] == ref[2], (res[2], ref[2])
+        assert np.asarray(res[0]).tobytes() == np.asarray(ref[0]).tobytes()
+        assert res[3].tobytes() == ref[3].tobytes(), (
+            f"cluster diverges from single-host at n={n} d={d} "
+            f"hosts={hosts}"
+        )
+        ms = _timed(_dirty_round(cp, P, d, n), repeats)
+        st = cp.cluster_stats()
+        row["hosts"][str(hosts)] = {
+            "merge_ms": round(ms, 2),
+            "speedup": round(single_ms / ms, 2) if ms else None,
+            "host_pruned_fraction": st["host_pruned_fraction"],
+            "rows_shipped": st["rows_shipped"],
+        }
+    return row
+
+
+def bench_prune(n: int, d: int, P: int, hosts: int, repeats: int) -> dict:
+    """The host-witness prefilter leg: a skewed stream where one host's
+    witness dominates every other host, so the cross-host merge touches
+    one host-local root instead of ``hosts`` — and the dominated hosts
+    ship ZERO interconnect bytes."""
+    from skyline_tpu.cluster import ClusterPartitionSet
+    from skyline_tpu.stream.batched import PartitionSet
+
+    x = _stream(n, d, P, skew=True)
+    single = PartitionSet(P, d, buffer_size=max(n, 1024))
+    _feed(single, x, P)
+    ref = single.global_merge_stats(emit_points=True)
+
+    def run(prune_on: bool):
+        os.environ["SKYLINE_CLUSTER_HOST_PRUNE"] = "1" if prune_on else "0"
+        cp = ClusterPartitionSet(P, d, max(n, 1024), hosts=hosts)
+        _feed(cp, x, P)
+        res = cp.global_merge_stats(emit_points=True)  # warm
+        assert res[2] == ref[2], (res[2], ref[2])
+        assert res[3].tobytes() == ref[3].tobytes(), (
+            f"host-pruned merge diverges at n={n} d={d} hosts={hosts} "
+            f"prune={prune_on}"
+        )
+        ms = _timed(_dirty_round(cp, P, d, n), repeats)
+        return cp, ms
+
+    cp_off, off_ms = run(prune_on=False)
+    cp_on, on_ms = run(prune_on=True)
+    st = cp_on.cluster_stats()
+    return {
+        "n": n,
+        "d": d,
+        "partitions": P,
+        "hosts": hosts,
+        "skyline_size": int(ref[2]),
+        "prune_off_ms": round(off_ms, 2),
+        "prune_on_ms": round(on_ms, 2),
+        "prune_speedup": round(off_ms / on_ms, 2) if on_ms else None,
+        "hosts_pruned": st["hosts_pruned"],
+        "host_pruned_fraction": st["host_pruned_fraction"],
+        "rows_shipped": st["rows_shipped"],
+        "rows_saved": st["rows_saved"],
+        "ship_saved_fraction": st["ship_saved_fraction"],
+    }
+
+
+def bench_promotion(tmp_dir: str, repeats: int) -> dict:
+    """Time-to-promote: primary publishes N versions through a fenced
+    writer and goes dark; the supervisor tick fences + promotes the
+    caught-up replica. Identity (digest of the promoted head vs the
+    primary's last durable publish) is asserted before the wall time
+    counts."""
+    import shutil
+
+    from skyline_tpu.cluster import (
+        ClusterSupervisor,
+        FencedWalWriter,
+        LeasePlane,
+    )
+    from skyline_tpu.serve import SnapshotStore, delta_wal_record
+    from skyline_tpu.serve.replica import SkylineReplica
+    from skyline_tpu.serve.snapshot import points_digest
+
+    rng = np.random.default_rng(7)
+    walls = []
+    head_versions = []
+    for rep in range(repeats):
+        d = os.path.join(tmp_dir, f"promo-{rep}")
+        shutil.rmtree(d, ignore_errors=True)
+        clock = {"now": 0.0}
+        plane = LeasePlane(d, clock=lambda: clock["now"])
+        lease = plane.acquire("primary-0", ttl_ms=500.0)
+        writer = FencedWalWriter(d, lease.epoch, plane=plane, fsync="off")
+        store = SnapshotStore()
+
+        def shadow(prev, snap):
+            writer.append(delta_wal_record(prev, snap))
+            writer.flush(force=True)
+
+        store.on_publish(shadow)
+        pts = rng.random((256, 4)).astype(np.float32)
+        for i in range(1, 9):
+            store.publish(pts[: i * 32], watermark_id=i * 32)
+        replica = SkylineReplica(d, replica_id="r0", start=False)
+        replica.bootstrap()
+        while replica.apply_available():
+            pass
+        sup = ClusterSupervisor(
+            d, [replica], lease_ttl_ms=500.0, clock=lambda: clock["now"]
+        )
+        clock["now"] = 10_000.0  # primary dead: lease expired
+        doc = sup.tick()
+        assert doc is not None and doc["holder"] == "r0"
+        assert doc["head_version"] == store.head_version
+        assert doc["head_digest"] == points_digest(store.latest().points)
+        # the deposed writer is fenced at the WAL layer
+        try:
+            writer.append({"type": "delta", "probe": True})
+            raise AssertionError("deposed append must be rejected")
+        except Exception:
+            pass
+        walls.append(doc["time_to_promote_ms"])
+        head_versions.append(doc["head_version"])
+        replica.close()
+        writer.close()
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "repeats": repeats,
+        "head_version": head_versions[-1],
+        "time_to_promote_ms": round(float(np.median(walls)), 3),
+        "time_to_promote_p_max_ms": round(float(np.max(walls)), 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--sizes", type=int, nargs="+", default=[65536, 262144])
+    ap.add_argument("--dims", type=int, nargs="+", default=[8])
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--hosts", type=int, nargs="+", default=[2, 4])
+    ap.add_argument("--chips-per-host", type=int, default=1)
+    ap.add_argument("--out", default="artifacts/cluster_ab.json")
+    a = ap.parse_args(argv)
+
+    import jax
+
+    # belt and braces (same as run_configs.py): pin the backend for real
+    if env_str("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    for hosts in a.hosts:
+        if a.partitions % hosts:
+            raise SystemExit(
+                f"partitions {a.partitions} not divisible by hosts {hosts}"
+            )
+        group = a.partitions // hosts
+        if a.chips_per_host > 1 and group % a.chips_per_host:
+            raise SystemExit(
+                f"group {group} not divisible by chips_per_host "
+                f"{a.chips_per_host}"
+            )
+
+    prev = os.environ.get("SKYLINE_CLUSTER_HOST_PRUNE")  # lint: allow-raw-env
+    results = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "device_count": jax.device_count(),
+        "rows": [],
+        "prune_rows": [],
+        "promotion": None,
+    }
+    try:
+        for n in a.sizes:
+            for d in a.dims:
+                row = bench_one(
+                    n, d, a.partitions, a.hosts, a.chips_per_host, a.repeats
+                )
+                print(json.dumps(row), flush=True)
+                results["rows"].append(row)
+                prow = bench_prune(
+                    n, d, a.partitions, max(a.hosts), a.repeats
+                )
+                print(json.dumps(prow), flush=True)
+                results["prune_rows"].append(prow)
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="skyline-promo-") as td:
+            promo = bench_promotion(td, a.repeats)
+        print(json.dumps(promo), flush=True)
+        results["promotion"] = promo
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLINE_CLUSTER_HOST_PRUNE", None)
+        else:
+            os.environ["SKYLINE_CLUSTER_HOST_PRUNE"] = prev
+    if a.out:
+        os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
+        with open(a.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,6 +82,13 @@ METRICS = (
     # regression); absent (pre-sharded artifacts) skips, never fails
     ("sharded.pruned_chip_fraction", ("sharded", "pruned_chip_fraction"),
      True, False),
+    # cluster leg (bench.py cluster_leg / benchmarks/cluster.py): the
+    # skewed probe's host-witness prefilter fraction — a drop means
+    # whole-host pruning in the cross-host tournament went dead (stale
+    # host summaries / SKYLINE_CLUSTER_HOST_PRUNE regression); absent
+    # (pre-cluster artifacts) skips, never fails
+    ("cluster.host_pruned_fraction", ("cluster", "host_pruned_fraction"),
+     True, False),
     # flush-cascade leg: the grid prefilter's drop fraction going to ~0
     # means the quantized summaries stopped certifying drops (stale grid /
     # validation disabling every dim / gating bug) — deterministic on any

@@ -394,3 +394,158 @@ print("[chaos-smoke] replica drill ok: 6 versions byte-identical on 2 "
       "replicas -> engine killed -> honest aging + fenced 503 -> restart "
       "-> reconverged via tail (no re-bootstrap)")
 EOF
+
+# promotion drill (ISSUE 16, RUNBOOK §2r): a lease-holding primary
+# publishing through a FencedWalWriter goes dark mid-burst; the
+# ClusterSupervisor must fence the dead epoch and promote the
+# most-caught-up WAL-tailing replica within the lease TTL, the promoted
+# head must serve byte-identical answers over HTTP, every post-fence
+# append from the deposed epoch must be rejected AT THE WAL LAYER, and
+# the deposed node must be able to rejoin as a demoted follower that
+# reconverges through the tail at the NEW epoch
+JAX_PLATFORMS=cpu python - <<'EOF'
+import hashlib
+import shutil
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from skyline_tpu.cluster import (
+    ClusterSupervisor,
+    FencedWalWriter,
+    LeasePlane,
+    WalFencedError,
+)
+from skyline_tpu.serve import SkylineServer, SnapshotStore, delta_wal_record
+from skyline_tpu.serve.replica import SkylineReplica
+from skyline_tpu.serve.snapshot import points_digest
+
+
+def get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+wal_dir = tempfile.mkdtemp(prefix="skyline-promo-drill-")
+rng = np.random.default_rng(31)
+TTL_MS = 600.0
+plane = LeasePlane(wal_dir)
+lease = plane.acquire("primary-0", ttl_ms=TTL_MS)
+writer = FencedWalWriter(wal_dir, lease.epoch, plane=plane, fsync="off")
+
+
+def shadow(prev, snap):
+    writer.append(delta_wal_record(prev, snap))
+    writer.flush(force=True)
+
+
+store = SnapshotStore()
+store.on_publish(shadow)
+primary = SkylineServer(store, port=0)
+rep_a = SkylineReplica(wal_dir, replica_id="rep-a",
+                       poll_interval_s=0.005, start=True)
+rep_b = SkylineReplica(wal_dir, replica_id="rep-b",
+                       poll_interval_s=0.005, start=True)
+writer2 = None
+try:
+    # burst under a live lease, renewing on cadence like a real primary
+    for v in range(1, 7):
+        store.publish(rng.random((96, 4)).astype(np.float32))
+        lease = plane.renew(lease)
+        assert rep_a.wait_for_version(v, timeout_s=10.0)
+        assert rep_b.wait_for_version(v, timeout_s=10.0)
+    _, pbytes, phead = get(
+        f"http://127.0.0.1:{primary.port}/skyline?format=csv"
+    )
+    # ---- primary goes dark: no more renewals, no more publishes ----
+    primary.close()
+    dark_t0 = time.perf_counter()
+    sup = ClusterSupervisor(
+        wal_dir, [rep_a, rep_b], lease_ttl_ms=TTL_MS
+    )
+    doc = None
+    while doc is None:
+        if (time.perf_counter() - dark_t0) * 1000.0 > 20 * TTL_MS:
+            raise AssertionError("no promotion within 20x the lease TTL")
+        doc = sup.tick()
+        if doc is None:
+            time.sleep(0.02)
+    dark_ms = (time.perf_counter() - dark_t0) * 1000.0
+    promoted = rep_a if doc["holder"] == "rep-a" else rep_b
+    follower = rep_b if promoted is rep_a else rep_a
+    assert doc["deposed"] == "primary-0", doc
+    assert doc["epoch"] > lease.epoch, (doc["epoch"], lease.epoch)
+    # the promotion step itself fits inside one lease TTL — the write
+    # path is dark for (expiry wait + tick cadence + promote), and the
+    # promote component is the part this plane owns
+    assert doc["time_to_promote_ms"] < TTL_MS, doc["time_to_promote_ms"]
+    # byte-identity over HTTP: the promoted head IS the deposed
+    # primary's last durable publish
+    assert doc["head_digest"] == points_digest(store.latest().points)
+    code, rbytes, rhead = get(
+        f"http://127.0.0.1:{promoted.port}/skyline?format=csv"
+    )
+    assert code == 200 and promoted.role == "primary"
+    assert rhead["X-Skyline-Version"] == phead["X-Skyline-Version"]
+    assert hashlib.sha256(rbytes).hexdigest() == \
+        hashlib.sha256(pbytes).hexdigest(), "promoted head diverged"
+    # the deposed epoch is fenced AT THE WAL LAYER: the exact append the
+    # zombie's publish hook would issue dies before the write syscall
+    # (probing the writer directly, not store.publish — a publish would
+    # advance the zombie's in-memory version chain past the durable
+    # tail, which is precisely the divergence the fence exists to stop)
+    try:
+        writer.append({"type": "delta", "probe": True})
+        raise AssertionError("deposed primary's post-fence append landed")
+    except WalFencedError:
+        pass
+    assert writer.fenced_writes == 1, writer.fenced_writes
+    # supervisor keeps renewing on behalf of the promoted holder
+    assert sup.tick() is None
+    assert not plane.read_lease().expired(time.time() * 1000.0)
+    # ---- the new epoch writes; the deposed node rejoins demoted ----
+    writer2 = FencedWalWriter(wal_dir, doc["epoch"], plane=plane,
+                              fsync="off")
+
+    def shadow2(prev, snap):
+        writer2.append(delta_wal_record(prev, snap))
+        writer2.flush(force=True)
+
+    store._subscribers = [shadow2]  # stand-in for the new primary's WAL
+    head = store.head_version
+    rejoin = SkylineReplica(wal_dir, replica_id="primary-0-rejoined",
+                            poll_interval_s=0.005, start=True)
+    try:
+        promoted.demote()  # honest path once its writer starts fencing
+        assert promoted.role == "replica"
+        for v in range(head + 1, head + 3):
+            store.publish(rng.random((96, 4)).astype(np.float32))
+        for rep in (promoted, follower, rejoin):
+            assert rep.wait_for_version(head + 2, timeout_s=10.0), (
+                rep.replica_id
+            )
+            assert rep.store.latest().points.tobytes() == \
+                store.latest().points.tobytes(), (
+                    f"{rep.replica_id} diverged after rejoin"
+                )
+    finally:
+        rejoin.close()
+    print(f"[chaos-smoke] promotion drill ok: primary dark -> fenced + "
+          f"promoted {doc['holder']} (epoch {doc['epoch']}, "
+          f"promote {doc['time_to_promote_ms']:.1f}ms, dark "
+          f"{dark_ms:.0f}ms) -> HTTP byte-identical -> zombie append "
+          f"rejected -> rejoined demoted, reconverged at the new epoch")
+finally:
+    rep_a.close()
+    rep_b.close()
+    if writer2 is not None:
+        writer2.close()
+    writer.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+EOF

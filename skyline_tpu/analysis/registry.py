@@ -299,6 +299,27 @@ KNOBS: tuple[Knob, ...] = (
        "run as a standalone read replica tailing this WAL directory "
        "instead of a worker (mutually exclusive with --replicas)",
        "job flag", runbook="§2q", job_field="replica_of"),
+    _k("SKYLINE_CLUSTER_HOSTS", "int", 0,
+       "multi-host cluster ingest: partition the stream across this many "
+       "host-level partition groups with a third (host) tournament merge "
+       "level (0 = single host)", "job flag", runbook="§2r",
+       job_field="cluster_hosts"),
+    _k("SKYLINE_CLUSTER_LEASE_TTL_MS", "float", 3000.0,
+       "write-lease time-to-live: the primary must renew within this "
+       "window or the ClusterSupervisor fences its epoch and promotes "
+       "the most-caught-up replica", "cluster", runbook="§2r"),
+    _k("SKYLINE_CLUSTER_LEASE_RENEW_MS", "float", 0.0,
+       "primary lease renew cadence (0 = TTL/3); must be well under the "
+       "TTL or the primary deposes itself", "cluster", runbook="§2r"),
+    _k("SKYLINE_CLUSTER_HOST_PRUNE", "bool", True,
+       "host-level witness prefilter in the cluster merge: a host whose "
+       "summary is witness-dominated ships zero rows to the coordinator "
+       "(byte-identical either way)", "cluster", runbook="§2r"),
+    _k("SKYLINE_CLUSTER_MIGRATION_BUDGET", "int", 8,
+       "max live partition-group migrations between hosts per coordinator "
+       "lifetime (drain/checkpoint-slice/restore/fence cycles); guards "
+       "against health-signal flapping thrashing state", "cluster",
+       runbook="§2r"),
     _k("SKYLINE_REPLICA_MAX_STALE_MS", "float", 30_000.0,
        "replica staleness fence: reads whose snapshot is older than this "
        "are refused with 503 + Retry-After instead of served silently "
@@ -533,6 +554,9 @@ KNOBS: tuple[Knob, ...] = (
        "replica-leg publish transitions tailed", "bench"),
     _k("BENCH_REPLICA_ROWS", "int", 2048,
        "replica-leg rows per published snapshot", "bench"),
+    _k("BENCH_CLUSTER", "bool", True,
+       "run the cluster-plane bench leg (host-prune probe + promotion "
+       "drill)", "bench", runbook="§2r"),
     _k("BENCH_SERVE_POINTS", "bool", False,
        "serve-leg full-payload reads instead of metadata-only", "bench"),
     _k("BENCH_COMPILE_CACHE", "str", None,

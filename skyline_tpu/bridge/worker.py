@@ -34,6 +34,7 @@ class SkylineWorker:
         output_topic: str = OUTPUT_TOPIC,
         mesh=None,
         mesh_chips: int = 0,
+        cluster_hosts: int = 0,
         stats_port: int | None = None,
         window_size: int = 0,
         slide: int = 0,
@@ -104,7 +105,14 @@ class SkylineWorker:
             raise ValueError(
                 "sliding-window mode does not support mesh_chips"
             )
+        if cluster_hosts and mesh is not None:
+            raise ValueError("mesh and cluster_hosts are mutually exclusive")
+        if cluster_hosts and window_size:
+            raise ValueError(
+                "sliding-window mode does not support cluster_hosts"
+            )
         self.mesh_chips = int(mesh_chips)
+        self.cluster_hosts = int(cluster_hosts)
         self.bus = bus
         self.max_drain_polls = max_drain_polls
         self.tracer = tracer if tracer is not None else Tracer(sync_device=False)
@@ -126,6 +134,9 @@ class SkylineWorker:
         self._ckpt_mgr = None
         self._wal = None
         self._chip_wal = None
+        self._lease_plane = None
+        self._lease_keeper = None
+        self._deposed = False
         self._snap_store = None
         self._serve_ring = None
         self._data_pos = 0  # consumed data-topic records (replay currency)
@@ -155,7 +166,8 @@ class SkylineWorker:
                 telemetry=self.telemetry,
             )
             hit = self._ckpt_mgr.restore_latest(
-                mesh=mesh, mesh_chips=mesh_chips, tracer=self.tracer,
+                mesh=mesh, mesh_chips=mesh_chips,
+                cluster_hosts=cluster_hosts, tracer=self.tracer,
                 telemetry=self.telemetry,
             )
             ckpt_path = None
@@ -196,6 +208,17 @@ class SkylineWorker:
             # passed config so a restarted incarnation can't silently change
             # result semantics mid-stream
             self.engine = restored_engine
+        elif cluster_hosts:
+            # multi-host cluster ingest (RUNBOOK §2r): mesh_chips becomes
+            # the per-host chip count, so --cluster-hosts 4 --mesh-chips 2
+            # runs the full three-level tournament
+            from skyline_tpu.cluster import ClusterEngine
+
+            self.engine = ClusterEngine(
+                config, hosts=cluster_hosts,
+                chips_per_host=mesh_chips or 1, tracer=self.tracer,
+                telemetry=self.telemetry,
+            )
         elif mesh_chips:
             from skyline_tpu.distributed import ShardedEngine
 
@@ -261,8 +284,7 @@ class SkylineWorker:
             from skyline_tpu.analysis.registry import env_float
             from skyline_tpu.resilience.wal import WalWriter
 
-            self._wal = WalWriter(
-                self._wal_dir,
+            wal_kw = dict(
                 segment_bytes=resilience.wal_segment_bytes,
                 fsync=resilience.wal_fsync,
                 telemetry=self.telemetry,
@@ -271,11 +293,48 @@ class SkylineWorker:
                 # dead replica can't pin the log forever
                 tailer_ttl_s=env_float("SKYLINE_WAL_TAILER_TTL_S", 600.0),
             )
+            if cluster_hosts:
+                # write-path HA (RUNBOOK §2r): this worker is the lease
+                # holder; every WAL frame carries its fencing token, and
+                # the instant another primary is promoted over us every
+                # append is rejected at the WAL layer
+                from skyline_tpu.cluster import (
+                    FencedWalWriter,
+                    LeaseKeeper,
+                    LeasePlane,
+                )
+
+                self._lease_plane = LeasePlane(self._wal_dir)
+                self._lease_keeper = LeaseKeeper(
+                    self._lease_plane,
+                    f"worker-{os.getpid()}",
+                    telemetry=self.telemetry,
+                )
+                if self._lease_keeper.acquire() is None:
+                    held = self._lease_plane.read_lease()
+                    raise ValueError(
+                        "write lease is held by "
+                        f"{held.holder!r} (epoch {held.epoch}); refusing to "
+                        "start a second primary against the same WAL"
+                    )
+                self._wal = FencedWalWriter(
+                    self._wal_dir,
+                    self._lease_keeper.epoch,
+                    plane=self._lease_plane,
+                    **wal_kw,
+                )
+                status = getattr(self.telemetry, "cluster", None)
+                if status is not None:
+                    status.node_id = self._lease_keeper.holder
+                    status.role = "primary"
+                    status.lease_cb = self._lease_plane.doc
+            else:
+                self._wal = WalWriter(self._wal_dir, **wal_kw)
             # chip-local WAL segments for the sharded engine: per-chip
             # flush lineage + merge-time consistency barriers (policy
             # "merge", the default), or checkpoint-time barriers only
             # ("checkpoint"); "off" skips the plane entirely
-            if self.mesh_chips:
+            if self.mesh_chips and not cluster_hosts:
                 from skyline_tpu.ops.dispatch import chip_barrier_policy
                 from skyline_tpu.resilience.chip_wal import ChipWalPlane
 
@@ -369,6 +428,13 @@ class SkylineWorker:
             }
             if self._wal is not None:
                 res["wal"] = self._wal.stats()
+            if self._lease_keeper is not None:
+                res["lease"] = {
+                    "holder": self._lease_keeper.holder,
+                    "epoch": self._lease_keeper.epoch,
+                    "deposed": self._deposed,
+                    **self._lease_plane.doc(),
+                }
             if self._chip_wal is not None:
                 res["chip_wal"] = self._chip_wal.stats()
             if self._recovered is not None:
@@ -664,12 +730,43 @@ class SkylineWorker:
     def shutdown(self) -> None:
         """Clean exit (SIGTERM/SIGINT): final checkpoint, force-fsync the
         WAL, close every server — a restart from this state replays
-        nothing and loses nothing."""
-        if self._ckpt_mgr is not None and self._dirty:
+        nothing and loses nothing. A DEPOSED worker skips the final
+        checkpoint: its WAL barrier would be rejected at the fence anyway,
+        and the promoted primary now owns the durable state."""
+        if self._ckpt_mgr is not None and self._dirty and not self._deposed:
             self.checkpoint_now()
         if self._wal is not None:
             self._wal.flush(force=True)
         self.close()
+
+    def _maybe_renew_lease(self) -> None:
+        """Renew the write lease when due; on deposition (a higher epoch
+        on disk, or the fence moved past ours) demote instead of writing
+        on — the honest half of the promotion drill."""
+        if self._lease_keeper is None or self._deposed:
+            return
+        from skyline_tpu.cluster import LeaseLostError
+
+        try:
+            self._lease_keeper.maybe_renew()
+        except LeaseLostError as e:
+            self._demote(str(e))
+
+    def _demote(self, reason: str) -> None:
+        """This worker lost the write path: stop ingesting, mark the role,
+        and let the loop exit WITHOUT a final checkpoint (the fence
+        rejects our barrier; the promoted primary owns durability now)."""
+        self._deposed = True
+        self._stop_requested = True
+        self.telemetry.inc("cluster.demotions")
+        status = getattr(self.telemetry, "cluster", None)
+        if status is not None:
+            status.role = "deposed"
+        print(
+            f"skyline worker: write lease lost ({reason}); demoting — "
+            "no further WAL appends, no final checkpoint",
+            file=sys.stderr,
+        )
 
     def _signal_handler(self, signum, frame) -> None:
         self._stop_requested = True
@@ -766,6 +863,9 @@ class SkylineWorker:
         in ``max_drain_polls * max_records`` drained rows.
         """
         fault_point("kafka.poll")
+        self._maybe_renew_lease()
+        if self._deposed:
+            return 0  # a deposed primary must not ingest another frame
         with self.tracer.phase("worker/poll"):
             triggers = self._queries.poll(max_records)
             ids, values, dropped, got = self._poll_data(max_records)
@@ -923,8 +1023,20 @@ class SkylineWorker:
             if self._stop_requested:
                 self.shutdown()
                 return
-            n = self.step()
+            try:
+                n = self.step()
+            except Exception as e:
+                from skyline_tpu.cluster import WalFencedError
+
+                if not isinstance(e, WalFencedError):
+                    raise
+                # an append raced the promotion past the renew check: the
+                # frame was rejected at the WAL layer (counted, loud) —
+                # demote and exit without the final checkpoint
+                self._demote(str(e))
+                continue
             if n == 0:
+                self._maybe_renew_lease()
                 now = time.time()
                 if idle_since is None:
                     idle_since = now
@@ -981,6 +1093,7 @@ def main(argv=None):
         output_topic=cfg.output_topic,
         mesh=cfg.build_mesh(),
         mesh_chips=cfg.mesh_chips,
+        cluster_hosts=cfg.cluster_hosts,
         stats_port=cfg.stats_port if cfg.stats_port > 0 else None,
         window_size=cfg.window_size,
         slide=cfg.slide,
@@ -998,6 +1111,7 @@ def main(argv=None):
         f"skyline worker: algo={cfg.algo} partitions={cfg.engine_config().num_partitions} "
         f"dims={cfg.dims} broker={cfg.bootstrap} mesh={cfg.mesh or 'off'}"
         f" chips={cfg.mesh_chips or 'off'}"
+        f" cluster={cfg.cluster_hosts or 'off'}"
         + (f" stats=:{worker.stats_server.port}" if worker.stats_server else "")
         + (f" serve=:{worker.serve_server.port}" if worker.serve_server else "")
         + (f" checkpoints={cfg.checkpoint_dir}" if cfg.checkpoint_dir else "")

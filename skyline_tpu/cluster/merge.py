@@ -1,0 +1,113 @@
+"""Host-level (third) tournament: the chip witness/summary prefilter
+lifted one level up (RUNBOOK §2r).
+
+The sharded engine's two-level merge (distributed/sharded.py) already
+proves the shape: level 1 builds each unit's local skyline root, a
+(2d+2)-float summary row per unit feeds ``prune_witness_mask``, and only
+surviving roots enter the pairwise ``tree_pair_merge`` ladder. Hosts are
+just bigger units — each host's "root" is the result of its OWN
+two-level merge (or flat merge at one chip), harvested through the
+uniform ``global_merge_launch``/``merge_points_device`` surface both
+``PartitionSet`` and ``ShardedPartitionSet`` expose.
+
+Why byte-identity survives a third level: ``tree_pair_merge`` emits the
+stable [a|b] compaction, so the FINAL root is always the global skyline
+in ascending partition id with per-partition storage order — a canonical
+form independent of the merge tree's shape. Any bracketing of hosts,
+chips, or partitions converges to the same bytes, which is what the
+host-count × chip-count × flush-policy identity grid asserts.
+
+Communication accounting: a host's summary is 2d+2 floats; a host whose
+summary is witness-dominated ships ZERO point rows to the coordinator
+(``prefilter`` theory per arxiv 1611.00423's communication-minimal
+cross-node skylines; witness machinery per arxiv 2411.14968). The
+coordinator records shipped rows/bytes per host so the benchmark's
+skewed leg can show the fraction saved.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from skyline_tpu.stream.window import (
+    _active_bucket,
+    partition_summaries_device,
+    prune_witness_mask,
+    tree_pair_merge,
+)
+
+
+def host_leaf(member, want_summary: bool):
+    """One host's tournament leaf: launch + harvest the member's own
+    merge, materialize the padded root points device-side, and (under
+    the host prune) its (2d+2) summary row — the exact shape of
+    ``ShardedPartitionSet._level1_chip``, one level up.
+
+    Returns ``(counts, surv, g, pts_dev, summary)`` with ``pts_dev`` /
+    ``summary`` None when the host is empty."""
+    h = member.global_merge_launch(False)
+    counts, surv, g, _ = member.global_merge_harvest(h)
+    pts = None
+    summary = None
+    if g > 0:
+        w = _active_bucket(max(g, 1))
+        pts = member.merge_points_device(h, w)
+        if want_summary:
+            summary = np.asarray(
+                partition_summaries_device(
+                    pts[None],
+                    jnp.asarray(np.array([g], dtype=np.int32)),
+                    w,
+                )
+            )[0]
+    return counts, surv, g, pts, summary
+
+
+def prune_hosts(summaries: list, alive: np.ndarray, d: int):
+    """Witness prune over host summaries: ``(pruned, witness_of)`` bool /
+    int64 vectors over hosts. Dead hosts contribute +inf rows (they can
+    neither prune nor be pruned — same convention as the chip level)."""
+    rows = [
+        s if s is not None else np.full(2 * d + 2, np.inf, dtype=np.float32)
+        for s in summaries
+    ]
+    return prune_witness_mask(np.stack(rows), alive, d)
+
+
+def tournament(leaves, root_dev):
+    """Pairwise merge ladder over host leaves, adjacent pairs in
+    ascending host order, odd tail passing through — identical bracket
+    discipline to the cross-chip level, so the final root lands in the
+    canonical ascending-pid order.
+
+    ``leaves``: ``[(vals_dev, pids_np_int32, g), ...]`` per surviving
+    host, ascending. Returns ``(root_vals, root_pids, root_cnt, levels,
+    candidates_per_level)``."""
+    nodes = []
+    for vals, pid_np, g in leaves:
+        nodes.append((
+            jax.device_put(vals, root_dev),
+            jax.device_put(pid_np, root_dev),
+            jax.device_put(np.int32(g), root_dev),
+            g,
+        ))
+    levels = 0
+    cand = [len(nodes)]
+    while len(nodes) > 1:
+        levels += 1
+        nxt = []
+        for i in range(0, len(nodes) - 1, 2):
+            av, ap, ac, aub = nodes[i]
+            bv, bp, bc, bub = nodes[i + 1]
+            out_cap = _active_bucket(max(aub + bub, 1))
+            vals, pids, cnt = tree_pair_merge(av, ap, ac, bv, bp, bc, out_cap)
+            nxt.append((vals, pids, cnt, min(aub + bub, out_cap)))
+        if len(nodes) % 2:
+            nxt.append(nodes[-1])
+        nodes = nxt
+        cand.append(len(nodes))
+    root_vals, root_pids, root_cnt, _ = nodes[0]
+    return root_vals, root_pids, root_cnt, levels, cand

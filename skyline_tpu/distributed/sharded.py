@@ -1050,6 +1050,27 @@ class ShardedPartitionSet:
                 c["pts_host"] = np.asarray(c["pts_dev"])[: c["g"]].copy()
         return c["pts_host"].copy()
 
+    def merge_points_device(self, handle, out_cap: int):
+        """Device buffer of a harvested two-level merge's skyline points,
+        ``(out_cap, d)``, rows past the true count +inf-padded — the same
+        contract as ``PartitionSet.merge_points_device``, so the cluster
+        plane's host-level tournament (cluster/merge.py) can feed a
+        sharded host's root into ``tree_pair_merge`` without a host
+        round-trip. Valid between a harvest and the next flush; prefers
+        the facade cache buffer when it describes the handle's epoch."""
+        h = handle
+        cache = self._gm_cache
+        if cache is not None and cache["key"] == h.key:
+            pts = cache["pts_dev"]
+            if pts.shape[0] >= out_cap:
+                return pts[:out_cap]
+            return jnp.pad(
+                pts,
+                ((0, out_cap - pts.shape[0]), (0, 0)),
+                constant_values=jnp.inf,
+            )
+        return tree_points_device(h.root_vals, out_cap)
+
     # -- snapshots / audit / checkpoint --------------------------------------
 
     def sky_counts(self) -> np.ndarray:

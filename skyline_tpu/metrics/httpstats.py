@@ -34,6 +34,9 @@ Endpoints:
                 reports {"enabled": false})
   GET /health   chip-health block (RUNBOOK §2p): per-chip score/status +
                 quarantine state (flat workers report {"enabled": false})
+  GET /cluster  cluster block (RUNBOOK §2r): lease/role state, fenced
+                writes, promotions, per-host ingest/merge/prune stats
+                (non-cluster workers report {"enabled": false})
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -220,6 +223,11 @@ class StatsServer:
                             handler._reply(500, {"error": str(e)})
                 elif path == "/health":
                     handler._reply(200, outer._health_doc())
+                elif path == "/cluster":
+                    try:
+                        handler._reply(200, outer._cluster_doc())
+                    except Exception as e:
+                        handler._reply(500, {"error": str(e)})
                 elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
@@ -305,6 +313,19 @@ class StatsServer:
         doc["ok"] = not doc.get("quarantined")
         doc["enabled"] = True
         return doc
+
+    def _cluster_doc(self) -> dict:
+        """The /cluster block (RUNBOOK §2r): lease/role state + per-host
+        ingest/merge/prune stats. Probe-friendly on non-cluster workers —
+        ``enabled`` is false when no ClusterStatus is attached."""
+        status = (
+            getattr(self.telemetry, "cluster", None)
+            if self.telemetry is not None
+            else None
+        )
+        if status is None:
+            return {"ok": True, "enabled": False}
+        return status.doc()
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

@@ -122,6 +122,22 @@ def chip_prune_enabled() -> bool:
     return env_bool("SKYLINE_CHIP_PRUNE", True)
 
 
+def host_prune_enabled() -> bool:
+    """``SKYLINE_CLUSTER_HOST_PRUNE`` gates the HOST-level witness
+    prefilter in the cluster coordinator's three-level merge
+    (``cluster/merge.py``): each host's tournament root is summarized as
+    one ``[min_corner | witness | sums]`` row, and a host whose
+    min-corner is strictly dominated by another host's witness ships
+    ZERO point rows to the coordinator — the chip prune
+    (``chip_prune_enabled``) applied one level up, same soundness
+    argument, so the published bytes are identical either way. Default
+    ON; set ``0`` to gather every non-empty host (the A/B baseline
+    benchmarks/cluster.py compares against). Read lazily per query."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_CLUSTER_HOST_PRUNE", True)
+
+
 def chip_barrier_policy() -> str:
     """``SKYLINE_CHIP_BARRIER`` picks when the sharded engine writes its
     chip-consistency barrier records (``resilience/chip_wal.py``):

@@ -72,7 +72,8 @@ class CheckpointManager:
                     pass
         return path
 
-    def restore_latest(self, mesh=None, mesh_chips: int = 0, tracer=None,
+    def restore_latest(self, mesh=None, mesh_chips: int = 0,
+                       cluster_hosts: int = 0, tracer=None,
                        telemetry=None):
         """Newest CRC-valid checkpoint as ``(engine, meta, path)``, or None
         when the directory holds no loadable checkpoint. A bad file (torn
@@ -83,7 +84,8 @@ class CheckpointManager:
         for _seq, path in reversed(self.list()):
             try:
                 engine, meta = load_engine(
-                    path, mesh=mesh, mesh_chips=mesh_chips, with_meta=True,
+                    path, mesh=mesh, mesh_chips=mesh_chips,
+                    cluster_hosts=cluster_hosts, with_meta=True,
                     tracer=tracer, telemetry=telemetry,
                 )
             except Exception as e:

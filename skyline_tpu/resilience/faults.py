@@ -52,6 +52,8 @@ KILL_POINTS = frozenset(
         "replica.tail",  # serve/replica.py tail-loop iteration entry
         "replica.restore",  # serve/replica.py bootstrap entry
         "wal.rotate_during_tail",  # resilience/wal.py segment rotation
+        "cluster.lease_expire",  # cluster/lease.py supervisor expiry branch
+        "wal.stale_fence",  # cluster/lease.py fenced-append rejection
     )
 )
 

@@ -118,6 +118,10 @@ class SkylineReplica:
             role="replica",
         )
         self.port = self.server.port
+        # cluster role (RUNBOOK §2r): "replica" until a ClusterSupervisor
+        # promotes this node to own the write path under a lease epoch
+        self.role = "replica"
+        self.promoted_epoch: int | None = None
         self._tailer: WalTailer | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -359,6 +363,56 @@ class SkylineReplica:
         )
         self._thread.start()
 
+    def promote(self, epoch: int) -> dict:
+        """Promotion hook for the ``ClusterSupervisor`` (the fence is
+        already raised past the deposed epoch, so the durable WAL tail
+        can no longer move under us): stop the supervised tail thread,
+        drain every durable record, and switch to the primary role.
+
+        Returns ``{head_version, head_digest}`` — the byte-identity
+        witness: because every fold is digest-verified against the
+        primary's published bytes, the promoted head IS the deposed
+        primary's last durable state plus replayed deltas, and the drills
+        assert the sha256 matches an independent fold of the WAL."""
+        from skyline_tpu.serve.snapshot import points_digest
+
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        try:
+            if self._tailer is None:
+                self.bootstrap()
+            while self.apply_available():
+                pass
+        except WalError as e:
+            # damaged tail at the worst moment: re-bootstrap from the
+            # newest barrier (state before the damage stays byte-exact)
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.rebootstraps += 1
+            self.bootstrap()
+        self.role = "primary"
+        self.promoted_epoch = int(epoch)
+        self.server.role = "primary"
+        latest = self.store.latest()
+        return {
+            "head_version": self.store.head_version,
+            "head_digest": (
+                points_digest(latest.points) if latest is not None else None
+            ),
+        }
+
+    def demote(self) -> None:
+        """Rejoin as a follower after deposition — the honest path once
+        this node's writer starts raising ``WalFencedError``. Restarts
+        the supervised tail loop."""
+        self.role = "replica"
+        self.promoted_epoch = None
+        self.server.role = "replica"
+        if self._thread is None:
+            self._stop = threading.Event()
+            self.start()
+
     def wait_for_version(self, version: int, timeout_s: float = 10.0) -> bool:
         """Test/drill helper: block until the replica's head reaches
         ``version`` (True) or the timeout passes (False)."""
@@ -374,6 +428,8 @@ class SkylineReplica:
             "replica": {
                 "id": self.replica_id,
                 "wal_dir": self.wal_dir,
+                "role": self.role,
+                "promoted_epoch": self.promoted_epoch,
                 "head_version": self.store.head_version,
                 "records_applied": self.records_applied,
                 "bootstraps": self.bootstraps,

@@ -45,6 +45,9 @@ operator observability; this one serves the skyline itself. Endpoints:
                   report {"enabled": false}).
   GET  /health    chip-health block (RUNBOOK §2p): per-chip score/status +
                   quarantine state (flat workers report {"enabled": false}).
+  GET  /cluster   cluster block (RUNBOOK §2r): lease/role state, fenced
+                  writes, promotions, per-host ingest/merge/prune stats
+                  (non-cluster workers report {"enabled": false}).
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -529,6 +532,8 @@ class SkylineServer:
             await self._fleet(writer)
         elif path == "/health" and method == "GET":
             await self._health(writer)
+        elif path == "/cluster" and method == "GET":
+            await self._cluster(writer)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -817,6 +822,20 @@ class SkylineServer:
         doc["ok"] = not doc.get("quarantined")
         doc["enabled"] = True
         await self._reply(writer, 200, doc)
+
+    async def _cluster(self, writer):
+        """The /cluster block (RUNBOOK §2r): lease/role state, fenced-write
+        and promotion counters, per-host ingest/merge/prune stats.
+        Non-cluster workers report {"enabled": false} so probes can
+        distinguish "plane off" from "healthy single-host"."""
+        status = getattr(self.telemetry, "cluster", None)
+        if status is None:
+            await self._reply(writer, 200, {"ok": True, "enabled": False})
+            return
+        try:
+            await self._reply(writer, 200, status.doc())
+        except Exception as e:  # observability must not 500 the plane down
+            await self._reply(writer, 500, {"error": str(e)})
 
     async def _deltas(self, writer, params, tenant=None):
         ok, retry = self.admission.admit_read(tenant=tenant)

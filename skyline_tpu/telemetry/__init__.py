@@ -78,6 +78,11 @@ class Telemetry:
         # (None on flat workers); serves the /health chip block and the
         # quarantine state on /fleet
         self.health = None
+        # cluster plane (RUNBOOK §2r): a ``cluster.lease.ClusterStatus``
+        # attached by the cluster engine / the worker's lease wiring
+        # (None outside a cluster); serves GET /cluster on both HTTP
+        # surfaces and the skyline_host_*{host=...} metric families
+        self.cluster = None
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -151,6 +156,12 @@ class Telemetry:
         labeled_counters = labeled_gauges = None
         if self.fleet is not None:
             labeled_counters, labeled_gauges = self.fleet.labeled_series()
+        if self.cluster is not None:
+            host_counters, host_gauges = self.cluster.labeled_series()
+            if host_counters:
+                labeled_counters = {**(labeled_counters or {}), **host_counters}
+            if host_gauges:
+                labeled_gauges = {**(labeled_gauges or {}), **host_gauges}
         if extra_labeled_counters:
             # per-tenant admission series from the serve plane ride along
             # the fleet's per-chip families

@@ -48,7 +48,7 @@ class QueryPlan:
     """
 
     __slots__ = (
-        "trace_id", "query_id", "merge", "tree", "chips", "cascade",
+        "trace_id", "query_id", "merge", "tree", "chips", "hosts", "cascade",
         "kernels", "publish", "timing", "workload",
     )
 
@@ -58,6 +58,7 @@ class QueryPlan:
         self.merge: dict | None = None
         self.tree: dict | None = None
         self.chips: dict | None = None  # sharded engine only
+        self.hosts: dict | None = None  # cluster engine only
         self.cascade: dict | None = None
         self.kernels: list[dict] = []
         self.publish: dict | None = None
@@ -73,6 +74,7 @@ class QueryPlan:
             "merge": self.merge,
             "tree": self.tree,
             "chips": self.chips,
+            "hosts": self.hosts,
             "cascade": self.cascade,
             "kernels": self.kernels,
             "publish": self.publish,

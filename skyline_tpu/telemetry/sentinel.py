@@ -76,6 +76,12 @@ DEFAULT_RULES = (
     {"label": "replica.read_lag_p99_ms",
      "path": ["replica", "read_lag_p99_ms"], "higher_is_better": False,
      "threshold": 2.0},
+    # cluster plane (ISSUE 16): promotion stalling means a primary crash
+    # leaves the write path dark for longer; wall-clock on the CPU
+    # fallback is noisy, so only a blowup trips
+    {"label": "cluster.time_to_promote_ms",
+     "path": ["cluster", "time_to_promote_ms"], "higher_is_better": False,
+     "threshold": 2.0},
 )
 
 

@@ -128,15 +128,17 @@ def save_engine(engine: SkylineEngine, path: str, extra_meta: dict | None = None
 
 
 def load_engine(
-    path: str, mesh=None, mesh_chips: int = 0, with_meta: bool = False,
-    tracer=None, telemetry=None,
+    path: str, mesh=None, mesh_chips: int = 0, cluster_hosts: int = 0,
+    with_meta: bool = False, tracer=None, telemetry=None,
 ) -> SkylineEngine:
     """Restore an engine from a checkpoint written by ``save_engine``.
 
-    ``mesh``/``mesh_chips`` re-apply a device-placement choice (runtime
-    state, not checkpoint state — an engine saved on one topology restores
-    onto any; a single-device checkpoint restores into a sharded engine and
-    vice versa because ``restore_all`` splits by chip-owned partition id).
+    ``mesh``/``mesh_chips``/``cluster_hosts`` re-apply a device-placement
+    choice (runtime state, not checkpoint state — an engine saved on one
+    topology restores onto any; a single-device checkpoint restores into a
+    sharded or multi-host cluster engine and vice versa because
+    ``restore_all`` splits by owned partition id; with ``cluster_hosts``
+    set, ``mesh_chips`` becomes the per-host chip count).
     ``with_meta=True`` returns ``(engine, meta)`` so callers can read the
     ``extra`` doc (recovery offsets). ``tracer``/``telemetry`` thread the
     worker's observability hubs into the restored engine. A checkpoint
@@ -163,7 +165,14 @@ def load_engine(
             kw["tracer"] = tracer
         if telemetry is not None:
             kw["telemetry"] = telemetry
-        if mesh_chips:
+        if cluster_hosts:
+            from skyline_tpu.cluster import ClusterEngine
+
+            engine = ClusterEngine(
+                cfg, hosts=cluster_hosts, chips_per_host=mesh_chips or 1,
+                **kw,
+            )
+        elif mesh_chips:
             from skyline_tpu.distributed import ShardedEngine
 
             engine = ShardedEngine(cfg, chips=mesh_chips, **kw)

@@ -46,6 +46,11 @@ class JobConfig:
     # many per-chip groups with a two-level tournament merge
     # (skyline_tpu/distributed); mutually exclusive with mesh
     mesh_chips: int = 0
+    # >0: cluster engine (skyline_tpu/cluster) — partition ingest across
+    # this many hosts with a host-level tournament merge on top; with
+    # --checkpoint-dir the worker also runs the lease/fencing write-path
+    # (mesh_chips then means chips per host); mutually exclusive with mesh
+    cluster_hosts: int = 0
     stats_port: int = 0  # >0: serve /stats + /healthz on this port
     # sliding-window mode (both 0 = unbounded/tumbling, the reference's
     # semantics); window must be a multiple of slide
@@ -137,6 +142,17 @@ class JobConfig:
             raise ValueError(
                 "--mesh and --mesh-chips are mutually exclusive"
             )
+        if self.cluster_hosts < 0:
+            raise ValueError(
+                f"cluster_hosts must be >= 0, got {self.cluster_hosts}"
+            )
+        if self.cluster_hosts and self.mesh:
+            # the cluster engine owns placement end to end (per-host
+            # members pick their own devices); a mesh on top would
+            # double-shard, same as --mesh-chips
+            raise ValueError(
+                "--cluster-hosts and --mesh are mutually exclusive"
+            )
         if self.max_drain_polls < 1:
             raise ValueError(
                 f"max_drain_polls must be >= 1, got {self.max_drain_polls}"
@@ -211,6 +227,19 @@ class JobConfig:
                 f"num_partitions {num_partitions} must be divisible "
                 f"by mesh_chips {self.mesh_chips}"
             )
+        if self.cluster_hosts:
+            if num_partitions % self.cluster_hosts:
+                raise ValueError(
+                    f"num_partitions {num_partitions} must be divisible "
+                    f"by cluster_hosts {self.cluster_hosts}"
+                )
+            group = num_partitions // self.cluster_hosts
+            if self.mesh_chips and group % self.mesh_chips:
+                raise ValueError(
+                    f"per-host partition group {group} must be divisible "
+                    f"by mesh_chips {self.mesh_chips} (chips per host "
+                    "under --cluster-hosts)"
+                )
         if (self.window_size > 0) != (self.slide > 0):
             raise ValueError(
                 "--window and --slide must be given together (both > 0)"
@@ -225,6 +254,13 @@ class JobConfig:
             raise ValueError(
                 "sliding-window mode (--window/--slide) does not support "
                 "--mesh-chips"
+            )
+        if self.window_size and self.cluster_hosts:
+            # same reason: the sliding engine has no partition groups to
+            # split across hosts
+            raise ValueError(
+                "sliding-window mode (--window/--slide) does not support "
+                "--cluster-hosts"
             )
         if self.window_size and (
             self.grid_prefilter
@@ -397,6 +433,14 @@ def parse_job_args(argv=None) -> JobConfig:
                          "this many per-chip groups with a two-level "
                          "tournament merge (0 = single device; mutually "
                          "exclusive with --mesh)")
+    ap.add_argument("--cluster-hosts", type=int,
+                    default=env_int("SKYLINE_CLUSTER_HOSTS",
+                                    defaults.cluster_hosts),
+                    help="cluster engine: partition ingest across this "
+                         "many hosts with a host-level tournament merge "
+                         "on top (0 = off; --mesh-chips then means chips "
+                         "per host; with --checkpoint-dir the worker also "
+                         "runs the lease/fencing write path)")
     ap.add_argument("--stats-port", type=int,
                     default=env_int("SKYLINE_STATS_PORT", defaults.stats_port),
                     help="serve live /stats JSON on this port (0 = off)")
@@ -534,6 +578,7 @@ def parse_job_args(argv=None) -> JobConfig:
         ingest=a.ingest,
         mesh=a.mesh,
         mesh_chips=a.mesh_chips,
+        cluster_hosts=a.cluster_hosts,
         stats_port=a.stats_port,
         window_size=a.window_size,
         slide=a.slide,

@@ -1,0 +1,655 @@
+"""Cluster plane (ISSUE 16): lease-fenced primary promotion and
+multi-host partitioned ingest with a host-level tournament merge.
+
+Acceptance bars:
+
+- the N-host merge is byte-identical (rows AND order) to the flat
+  single-host engine for every host count x chip count x flush policy;
+- a deposed primary's post-fence append is REJECTED at the WAL layer
+  (``WalFencedError`` raised before the write syscall, counted, never
+  silently dropped);
+- the supervisor's promotion drill: lease expires, the most-caught-up
+  replica is promoted under a raised fence, and its head is
+  digest-identical to an independent fold of the durable WAL;
+- whole-host pruning under skew: a dominated host ships ZERO bytes into
+  the cross-host tournament and the answer does not change;
+- elastic rebalance: a partition group drained on one host restores on
+  another (possibly at a different chip count) with a byte-identical
+  next answer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from skyline_tpu.cluster import (
+    ClusterEngine,
+    ClusterPartitionSet,
+    ClusterStatus,
+    ClusterSupervisor,
+    FencedWalWriter,
+    LeaseKeeper,
+    LeaseLostError,
+    LeasePlane,
+    WalFencedError,
+)
+from skyline_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedCrash,
+    clear,
+    install_plan,
+)
+from skyline_tpu.resilience.wal import WalWriter, read_records
+from skyline_tpu.serve import (
+    SnapshotStore,
+    delta_wal_record,
+    snapshot_wal_record,
+)
+from skyline_tpu.serve.replica import SkylineReplica
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.stream.batched import PartitionSet
+from skyline_tpu.telemetry import Telemetry
+
+from conftest import (
+    assert_same_merge,
+    gen_points,
+    merge_state,
+    parse_prometheus_text,
+    points_digest_of,
+)
+
+P = 8  # divisible by every host x chip combination in the grid
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    clear()
+    yield
+    clear()
+
+
+def _feed_pset(pset, x: np.ndarray, chunk: int = 97) -> None:
+    """Identical ingest sequence for both engines: deterministic routing,
+    chunked adds, the engine's own flush cadence after every chunk — so a
+    cluster/flat pair sees byte-identical flush points."""
+    n = x.shape[0]
+    pids = np.arange(n) % P
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        for p in range(P):
+            rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+            if rows.shape[0]:
+                pset.add_batch(p, rows, max_id=hi, now_ms=0.0)
+        pset.maybe_flush()
+    pset.flush_all()
+
+
+def _skewed(rng, d=2):
+    """One host's partitions dominate: rows routed round-robin land a
+    dense near-origin cluster on partition 0 (host 0) while the rest sit
+    in the dominated upper quadrant — host 0's witness strictly dominates
+    every other host's min-corner."""
+    x = rng.random((448, d)).astype(np.float32) * 0.4 + 0.55
+    x[::P] = rng.random((56, d)).astype(np.float32) * 0.05 + 0.01
+    return x
+
+
+# --------------------------------------------------------------------------
+# lease / fence plane
+# --------------------------------------------------------------------------
+
+
+def test_lease_acquire_refuses_live_foreign_holder(tmp_path):
+    clock = {"now": 1000.0}
+    plane = LeasePlane(str(tmp_path), clock=lambda: clock["now"])
+    rec = plane.acquire("a", ttl_ms=500.0)
+    assert rec is not None and rec.epoch == 1 and rec.holder == "a"
+    # live foreign lease: politely refused
+    assert plane.acquire("b", ttl_ms=500.0) is None
+    # the holder itself may re-acquire (epoch advances: frames from the
+    # old epoch may still be racing toward the disk)
+    rec2 = plane.acquire("a", ttl_ms=500.0)
+    assert rec2.epoch == 2
+    # after expiry anyone may take it, again under a fresh epoch
+    clock["now"] += 10_000.0
+    rec3 = plane.acquire("b", ttl_ms=500.0)
+    assert rec3 is not None and rec3.holder == "b" and rec3.epoch == 3
+
+
+def test_lease_renew_detects_deposition(tmp_path):
+    clock = {"now": 0.0}
+    plane = LeasePlane(str(tmp_path), clock=lambda: clock["now"])
+    rec = plane.acquire("a", ttl_ms=500.0)
+    out = plane.renew(rec)
+    assert out.epoch == rec.epoch and out.renewed_ms == 0.0
+    # a fence raised past our epoch is deposition
+    plane.raise_fence(rec.epoch + 1)
+    with pytest.raises(LeaseLostError, match="fence"):
+        plane.renew(rec)
+    # so is a higher epoch on disk
+    plane2 = LeasePlane(str(tmp_path), clock=lambda: clock["now"])
+    plane2.acquire("b", ttl_ms=500.0, epoch=rec.epoch + 5)
+    with pytest.raises(LeaseLostError, match="epoch"):
+        plane.renew(rec)
+
+
+def test_fence_is_monotonic(tmp_path):
+    plane = LeasePlane(str(tmp_path))
+    assert plane.read_fence() == 0
+    assert plane.raise_fence(3) == 3
+    assert plane.raise_fence(1) == 3  # never lowers
+    assert plane.read_fence() == 3
+    # a second plane instance sees the fence through the file
+    assert LeasePlane(str(tmp_path)).read_fence() == 3
+
+
+def test_fenced_append_rejected_not_silently_dropped(tmp_path):
+    """The regression the fault verbs exist for: a deposed primary's
+    append must raise at the WAL layer, leave NOTHING on disk, and bump
+    the skyline_cluster_fenced_writes_total counter."""
+    d = str(tmp_path)
+    telem = Telemetry()
+    plane = LeasePlane(d)
+    rec = plane.acquire("primary-0", ttl_ms=1000.0)
+    w = FencedWalWriter(d, rec.epoch, plane=plane, fsync="off",
+                        telemetry=telem)
+    w.append({"type": "delta", "i": 0})
+    w.flush(force=True)
+    # promotion elsewhere: fence moves past our epoch
+    plane.raise_fence(rec.epoch + 1)
+    with pytest.raises(WalFencedError, match="behind"):
+        w.append({"type": "delta", "i": 1})
+    with pytest.raises(WalFencedError):
+        w.barrier({"type": "ckpt"})  # barriers are fenced too
+    w.close()
+    recs, torn = read_records(d)
+    deltas = [r for r in recs if r.get("type") == "delta"]
+    assert torn == 0
+    assert [r["i"] for r in deltas] == [0], "fenced frame must not land"
+    # every durable frame carries the fencing token
+    assert all(r["fence"] == rec.epoch for r in deltas)
+    assert w.fenced_writes == 2
+    assert w.stats()["fenced_writes"] == 2
+    snap = dict(telem.counters.snapshot())
+    assert snap["cluster.fenced_writes"] == 2
+    text = telem.render_prometheus()
+    series = parse_prometheus_text(text)
+    assert series["skyline_cluster_fenced_writes_total"][0][1] == 2.0
+
+
+def test_stale_fence_fault_verb_fires(tmp_path):
+    """``crash@wal.stale_fence:1`` must fire on the first fenced
+    rejection — the chaos harness's hook into this exact code path."""
+    d = str(tmp_path)
+    plane = LeasePlane(d)
+    rec = plane.acquire("primary-0", ttl_ms=1000.0)
+    w = FencedWalWriter(d, rec.epoch, plane=plane, fsync="off")
+    plane.raise_fence(rec.epoch + 1)
+    install_plan(FaultPlan.parse("crash@wal.stale_fence:1"))
+    with pytest.raises(InjectedCrash):
+        w.append({"type": "delta", "i": 0})
+    clear()
+    # with the plan cleared the same append raises the product error
+    with pytest.raises(WalFencedError):
+        w.append({"type": "delta", "i": 0})
+    w.close()
+
+
+def test_lease_keeper_renews_on_cadence(tmp_path):
+    clock = {"now": 0.0}
+    plane = LeasePlane(str(tmp_path), clock=lambda: clock["now"])
+    keeper = LeaseKeeper(plane, "w0", ttl_ms=300.0, renew_ms=100.0)
+    assert keeper.acquire() is not None
+    assert keeper.epoch == 1
+    assert keeper.maybe_renew() is False  # not due yet
+    clock["now"] = 150.0
+    assert keeper.maybe_renew() is True
+    assert keeper.record.renewed_ms == 150.0
+    plane.raise_fence(5)
+    clock["now"] = 300.0
+    with pytest.raises(LeaseLostError):
+        keeper.maybe_renew()
+
+
+# --------------------------------------------------------------------------
+# promotion drill: supervisor + WAL-tailing replicas
+# --------------------------------------------------------------------------
+
+
+def _primary(directory, plane, epoch, **writer_kw):
+    """A primary-shaped publish pipeline over a FENCED writer: the
+    SnapshotStore's publish hook shadows every transition into the WAL,
+    exactly like the worker does."""
+    writer = FencedWalWriter(directory, epoch, plane=plane, fsync="off",
+                             **writer_kw)
+
+    def shadow(prev, snap):
+        writer.append(delta_wal_record(prev, snap))
+        writer.flush(force=True)
+
+    store = SnapshotStore()
+    store.on_publish(shadow)
+    return store, writer
+
+
+def test_supervisor_promotes_most_caught_up_replica(rng, tmp_path):
+    d = str(tmp_path)
+    clock = {"now": 0.0}
+    telem = Telemetry()
+    plane = LeasePlane(d, clock=lambda: clock["now"])
+    lease = plane.acquire("primary-0", ttl_ms=500.0)
+    store, writer = _primary(d, plane, lease.epoch)
+    pts = rng.random((40, 3)).astype(np.float32)
+    for i in range(1, 6):
+        store.publish(pts[: i * 8], watermark_id=i * 8)
+    writer.barrier({"type": "ckpt",
+                    "snap": snapshot_wal_record(store.latest())})
+    store.publish(pts[:44], watermark_id=44)  # one delta past the barrier
+
+    # two replicas tail the WAL; r1 is deliberately behind (never polled)
+    r0 = SkylineReplica(d, replica_id="r0", start=False)
+    r1 = SkylineReplica(d, replica_id="r1", start=False)
+    r0.bootstrap()
+    while r0.apply_available():
+        pass
+    assert r0.store.head_version == store.head_version
+
+    sup = ClusterSupervisor(
+        d, [r0, r1], lease_ttl_ms=500.0, telemetry=telem,
+        clock=lambda: clock["now"],
+    )
+    assert sup.tick() is None  # lease live: nothing to do
+    clock["now"] = 10_000.0  # primary dead: lease expires
+    doc = sup.tick()
+    assert doc is not None
+    assert doc["holder"] == "r0", "most-caught-up replica wins"
+    assert doc["deposed"] == "primary-0"
+    assert doc["epoch"] > lease.epoch
+    assert doc["time_to_promote_ms"] >= 0.0
+    assert r0.role == "primary" and r0.promoted_epoch == doc["epoch"]
+    assert r1.role == "replica"
+
+    # byte-identity witness: the promoted head IS the deposed primary's
+    # last durable publish — digest equality against both the primary's
+    # own store and an independent WAL fold (a third fresh replica)
+    assert doc["head_version"] == store.head_version
+    assert doc["head_digest"] == points_digest_of(store.latest().points)
+    probe = SkylineReplica(d, replica_id="probe", start=False)
+    probe.bootstrap()
+    while probe.apply_available():
+        pass
+    assert points_digest_of(probe.store.latest().points) == doc["head_digest"]
+
+    # the deposed primary's writer is fenced at the WAL layer
+    with pytest.raises(WalFencedError):
+        writer.append({"type": "delta", "i": 99})
+    # and its keeper-side renewal sees the deposition
+    with pytest.raises(LeaseLostError):
+        plane.renew(lease)
+
+    # the supervisor now renews on behalf of the promoted holder
+    clock["now"] = 10_100.0
+    assert sup.tick() is None
+    assert plane.read_lease().renewed_ms == 10_100.0
+    assert sup.promotions == 1
+    assert dict(telem.counters.snapshot())["cluster.promotions"] == 1
+
+    # deposed node rejoins as a follower
+    r1.demote()  # no-op shape check on a never-promoted replica
+    sdoc = sup.doc()
+    assert sdoc["fence"] == doc["epoch"]
+    roles = {m["id"]: m["role"] for m in sdoc["members"]}
+    assert roles == {"r0": "primary", "r1": "replica"}
+    for r in (r0, r1, probe):
+        r.close()
+    writer.close()
+
+
+def test_promoted_replica_demotes_back_to_follower(rng, tmp_path):
+    d = str(tmp_path)
+    plane = LeasePlane(d)
+    lease = plane.acquire("p", ttl_ms=50.0)
+    store, writer = _primary(d, plane, lease.epoch)
+    store.publish(rng.random((8, 2)).astype(np.float32), watermark_id=8)
+    r = SkylineReplica(d, replica_id="r0", start=False)
+    r.promote(epoch=7)
+    assert r.role == "primary" and r.server.role == "primary"
+    assert r.stats()["replica"]["promoted_epoch"] == 7
+    r.demote()
+    assert r.role == "replica" and r.server.role == "replica"
+    assert r.promoted_epoch is None
+    # demote restarts the supervised tail loop; new publishes arrive
+    store.publish(rng.random((12, 2)).astype(np.float32), watermark_id=20)
+    assert r.wait_for_version(store.head_version, timeout_s=10.0)
+    r.close()
+    writer.close()
+
+
+# --------------------------------------------------------------------------
+# the acceptance grid: byte-identity of the three-level tournament
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+def test_cluster_matches_flat_grid(rng, kind):
+    d = 4
+    x = gen_points(rng, 600, d, kind)
+    for policy in ("incremental", "lazy"):
+        flat = PartitionSet(P, d, buffer_size=64, flush_policy=policy)
+        _feed_pset(flat, x)
+        base = merge_state(flat)
+        for hosts, chips in ((1, 1), (2, 1), (2, 2), (4, 2), (8, 1)):
+            cp = ClusterPartitionSet(
+                P, d, 64, hosts=hosts, chips_per_host=chips,
+                flush_policy=policy,
+            )
+            _feed_pset(cp, x)
+            assert_same_merge(
+                base, merge_state(cp),
+                ctx=f"kind={kind} hosts={hosts} chips={chips} "
+                    f"policy={policy}",
+            )
+
+
+def test_cluster_incremental_queries_and_cache(rng):
+    """Identity at every intermediate query, then a cache-hit repeat."""
+    d = 4
+    x = gen_points(rng, 600, d, "uniform")
+    flat = PartitionSet(P, d, buffer_size=64)
+    cp = ClusterPartitionSet(P, d, 64, hosts=4, chips_per_host=2)
+    n = x.shape[0]
+    pids = np.arange(n) % P
+    for lo in range(0, n, 150):
+        hi = min(lo + 150, n)
+        for ps in (flat, cp):
+            for p in range(P):
+                rows = np.ascontiguousarray(x[lo:hi][pids[lo:hi] == p])
+                if rows.shape[0]:
+                    ps.add_batch(p, rows, max_id=hi, now_ms=0.0)
+            ps.flush_all()
+        assert_same_merge(
+            merge_state(flat), merge_state(cp), ctx=f"after {hi} rows"
+        )
+    again = merge_state(cp)
+    assert_same_merge(merge_state(flat), again, ctx="cache-hit query")
+    assert cp.merge_cache_hits >= 1
+    assert cp.cluster_stats()["cache"]["hits"] >= 1
+
+
+def test_host_prune_fires_and_preserves_identity(rng):
+    """Skew: host 0's witness dominates every other host — dominated
+    hosts ship ZERO rows into the cross-host tournament and the answer
+    does not change by a byte."""
+    d = 2
+    x = _skewed(rng, d)
+    flat = PartitionSet(P, d, buffer_size=64)
+    _feed_pset(flat, x)
+    cp = ClusterPartitionSet(P, d, 64, hosts=4, chips_per_host=2)
+    _feed_pset(cp, x)
+    assert_same_merge(merge_state(flat), merge_state(cp), ctx="pruned")
+    stats = cp.cluster_stats()
+    assert stats["hosts"] == 4
+    assert stats["hosts_pruned"] > 0
+    assert 0.0 < stats["host_pruned_fraction"] <= 0.75
+    info = stats["last"]
+    pruned_ids = {e["host"] for e in info["pruned"]}
+    assert pruned_ids
+    for e in info["pruned"]:
+        assert e["witness"] not in pruned_ids, "witness chain must end alive"
+        # the interconnect contract: a pruned host shipped nothing
+        assert info["per_host"][e["host"]]["pruned"]
+    assert not (set(info["survivors"]) & pruned_ids)
+    assert info["rows_saved"] > 0
+    assert stats["rows_saved"] > 0
+
+
+def test_host_prune_knob_disables(rng, monkeypatch):
+    monkeypatch.setenv("SKYLINE_CLUSTER_HOST_PRUNE", "0")
+    d = 2
+    x = _skewed(rng, d)
+    flat = PartitionSet(P, d, buffer_size=64)
+    _feed_pset(flat, x)
+    cp = ClusterPartitionSet(P, d, 64, hosts=4)
+    _feed_pset(cp, x)
+    assert_same_merge(merge_state(flat), merge_state(cp), ctx="no-prune")
+    assert cp.cluster_stats()["hosts_pruned"] == 0
+
+
+# --------------------------------------------------------------------------
+# elastic rebalance: live migration + cross-host slice checkpoints
+# --------------------------------------------------------------------------
+
+
+def test_migrate_rebuilds_member_at_different_chip_count(rng):
+    d = 4
+    x = gen_points(rng, 500, d, "uniform")
+    flat = PartitionSet(P, d, buffer_size=64)
+    _feed_pset(flat, x)
+    base = merge_state(flat)
+    cp = ClusterPartitionSet(P, d, 64, hosts=2, chips_per_host=1)
+    _feed_pset(cp, x)
+    assert_same_merge(base, merge_state(cp), ctx="pre-migration")
+    doc = cp.migrate(1, chips=2, reason="drill")
+    assert doc["host"] == 1 and doc["chips"] == 2 and doc["source_fenced"]
+    assert cp._member_chips == [1, 2]
+    assert cp.fenced_sources == 1
+    # the next answer after the migration is byte-identical
+    assert_same_merge(base, merge_state(cp), ctx="post-migration")
+    # and ingest keeps routing to the new member
+    y = gen_points(rng, 200, d, "uniform")
+    _feed_pset(flat, y)
+    _feed_pset(cp, y)
+    assert_same_merge(merge_state(flat), merge_state(cp), ctx="post-ingest")
+    assert cp.cluster_stats()["migrations"] == 1
+
+
+def test_migration_budget_exhausts(rng, monkeypatch):
+    monkeypatch.setenv("SKYLINE_CLUSTER_MIGRATION_BUDGET", "2")
+    cp = ClusterPartitionSet(P, 2, 64, hosts=2)
+    _feed_pset(cp, gen_points(rng, 100, 2, "uniform"))
+    cp.migrate(0)
+    cp.migrate(1)
+    with pytest.raises(RuntimeError, match="budget"):
+        cp.migrate(0)
+
+
+def test_slice_checkpoint_restores_on_other_host(rng, tmp_path):
+    """Cross-host migration through the on-disk slice: host 1's group
+    checkpointed, then restored into a DIFFERENT facade's host 1 at a
+    different chip count — byte-identical next answer."""
+    d = 4
+    x = gen_points(rng, 500, d, "uniform")
+    flat = PartitionSet(P, d, buffer_size=64)
+    _feed_pset(flat, x)
+    base = merge_state(flat)
+    src = ClusterPartitionSet(P, d, 64, hosts=2, chips_per_host=2)
+    _feed_pset(src, x)
+    path = str(tmp_path / "slice.npz")
+    src.checkpoint_slice(1, path)
+    # the receiving cluster holds host 0's slice but an EMPTY host 1
+    dst = ClusterPartitionSet(P, d, 64, hosts=2, chips_per_host=2)
+    skies, pendings = src.audit_state()
+    G = src.group_size
+    empty_s = [np.empty((0, d), dtype=np.float32)] * G
+    empty_p = [np.empty((0, d), dtype=np.float32)] * G
+    dst.restore_all(skies[:G] + empty_s, pendings[:G] + empty_p)
+    doc = dst.restore_slice(1, path, chips=1)
+    assert doc["source_fenced"] and doc["chips"] == 1
+    assert dst._member_chips == [2, 1]
+    assert_same_merge(base, merge_state(dst), ctx="cross-host slice")
+
+
+def test_slice_checkpoint_detects_corruption(rng, tmp_path):
+    cp = ClusterPartitionSet(P, 2, 64, hosts=2)
+    _feed_pset(cp, gen_points(rng, 200, 2, "uniform"))
+    path = str(tmp_path / "slice.npz")
+    cp.checkpoint_slice(0, path)
+    # bit rot: perturb one array, keep the (now stale) meta CRC
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k].copy() for k in z.files}
+    sky = next(k for k in arrays if k.startswith("sky_")
+               and arrays[k].shape[0])
+    arrays[sky][0, 0] += 1.0
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    with pytest.raises(ValueError, match="CRC"):
+        cp.restore_slice(0, path)
+
+
+def test_quarantined_host_migrates_via_health_hook(rng):
+    from skyline_tpu.resilience.health import ChipHealth
+
+    cp = ClusterPartitionSet(P, 2, 64, hosts=2)
+    _feed_pset(cp, gen_points(rng, 200, 2, "uniform"))
+    base = merge_state(cp)
+    health = ChipHealth(2)
+    cp.attach_health(health)
+    health.quarantine(1, "drill")
+    assert 1 in health.quarantined()
+    healed = cp.maybe_failover()
+    assert healed == [1]
+    assert 1 not in health.quarantined()
+    assert cp.cluster_stats()["migrations"] == 1
+    assert_same_merge(base, merge_state(cp), ctx="post-quarantine")
+
+
+# --------------------------------------------------------------------------
+# engine level + observability surfaces
+# --------------------------------------------------------------------------
+
+
+def _run_engine(engine, x, trigger=True):
+    n = x.shape[0]
+    ids = np.arange(n, dtype=np.int64)
+    for lo in range(0, n, 128):
+        hi = min(lo + 128, n)
+        engine.process_records(ids[lo:hi], x[lo:hi])
+    if trigger:
+        engine.process_trigger("0,0")
+    out = []
+    for _ in range(200):
+        out.extend(engine.poll_results())
+        if out:
+            break
+    return out
+
+
+def test_cluster_engine_end_to_end_matches_flat(rng):
+    d = 4
+    cfg = EngineConfig(parallelism=4, dims=d, buffer_size=64,
+                       domain_max=1.0, emit_skyline_points=True)
+    x = gen_points(rng, 500, d, "uniform")
+    base = _run_engine(SkylineEngine(cfg), x)
+    telem = Telemetry()
+    eng = ClusterEngine(cfg, hosts=4, chips_per_host=2, telemetry=telem)
+    got = _run_engine(eng, x)
+    assert len(base) == len(got) == 1
+    assert got[0]["skyline_size"] == base[0]["skyline_size"]
+    np.testing.assert_array_equal(
+        np.asarray(got[0]["skyline_points"], dtype=np.float32),
+        np.asarray(base[0]["skyline_points"], dtype=np.float32),
+    )
+    stats = eng.stats()
+    assert stats["cluster"]["hosts"] == 4
+    assert stats["cluster"]["merges"] >= 1
+    per_host = stats["cluster"]["last"]["per_host"]
+    assert len(per_host) == 4
+    assert sum(r["records"] for r in per_host) == 500
+    # the explain plan carries host attribution
+    doc = telem.explain.latest()
+    assert doc is not None
+    hosts = doc.get("hosts")
+    assert hosts is not None and hosts["hosts"] == 4
+    assert doc["merge"]["path"] == "cluster_tree"
+    # the hub's ClusterStatus was attached and serves the coordinator doc
+    cdoc = telem.cluster.doc()
+    assert cdoc["enabled"] and cdoc["hosts"]["hosts"] == 4
+    # host-labeled Prometheus families render
+    series = parse_prometheus_text(telem.render_prometheus())
+    fam = series["skyline_host_records_total"]
+    assert {lab["host"] for lab, _ in fam} == {"0", "1", "2", "3"}
+    assert sum(v for _, v in fam) == 500.0
+    assert "skyline_host_skyline_size" in series
+
+
+def test_cluster_engine_rejects_device_ingest():
+    with pytest.raises(ValueError, match="ingest"):
+        ClusterEngine(
+            EngineConfig(parallelism=4, dims=2, ingest="device"), hosts=2
+        )
+
+
+def test_cluster_pset_validates_shape():
+    with pytest.raises(ValueError, match="divisible"):
+        ClusterPartitionSet(P, 2, 64, hosts=3)
+    with pytest.raises(ValueError, match="hosts"):
+        ClusterPartitionSet(P, 2, 64, hosts=0)
+    with pytest.raises(ValueError, match="divisible"):
+        ClusterPartitionSet(P, 2, 64, hosts=2, chips_per_host=3)
+
+
+def test_job_config_validates_cluster_hosts():
+    from skyline_tpu.utils.config import JobConfig
+
+    cfg = JobConfig(parallelism=4, cluster_hosts=2, mesh_chips=2)
+    assert cfg.cluster_hosts == 2
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        JobConfig(parallelism=2, mesh=2, cluster_hosts=2)
+    with pytest.raises(ValueError, match="divisible"):
+        JobConfig(parallelism=2, cluster_hosts=3)
+    with pytest.raises(ValueError, match="divisible"):
+        JobConfig(parallelism=4, cluster_hosts=4, mesh_chips=8)
+    with pytest.raises(ValueError, match="cluster"):
+        JobConfig(parallelism=2, cluster_hosts=2, window_size=64, slide=32)
+    with pytest.raises(ValueError):
+        JobConfig(parallelism=2, cluster_hosts=-1)
+
+
+def test_stats_server_cluster_endpoint(tmp_path):
+    import json
+    import urllib.request
+
+    from skyline_tpu.metrics.httpstats import StatsServer
+
+    telem = Telemetry()
+    srv = StatsServer(lambda: {"ok": 1}, port=0, telemetry=telem)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/cluster"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.load(r)
+        assert doc == {"ok": True, "enabled": False}
+        status = ClusterStatus(node_id="n0", role="primary")
+        status.lease_cb = lambda: {"fence": 3}
+        telem.cluster = status
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.load(r)
+        assert doc["enabled"] and doc["node"] == "n0"
+        assert doc["role"] == "primary" and doc["fence"] == 3
+    finally:
+        srv.close()
+
+
+def test_serve_plane_cluster_endpoint(tmp_path, rng):
+    """Replicas serve GET /cluster too — the second HTTP surface."""
+    import json
+    import urllib.request
+
+    d = str(tmp_path)
+    w = WalWriter(d, fsync="off")
+    w.append({"type": "delta", "from": 0, "to": 1, "d": 2,
+              "entered": "", "left": "", "keep": [], "wm": 1})
+    w.close()
+    r = SkylineReplica(d, replica_id="r0", start=False)
+    try:
+        url = f"http://127.0.0.1:{r.port}/cluster"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.load(resp)
+        assert doc == {"ok": True, "enabled": False}
+        status = ClusterStatus(node_id="r0", role="replica")
+        r.telemetry.cluster = status
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.load(resp)
+        assert doc["enabled"] and doc["role"] == "replica"
+    finally:
+        r.close()

@@ -272,6 +272,44 @@ def test_checkpoint_roundtrips_across_topologies(rng, tmp_path):
     assert flat.records_in == wide.records_in == eng.records_in
 
 
+def test_checkpoint_roundtrips_across_cluster_topologies(rng, tmp_path):
+    """ISSUE 16: a checkpoint taken under one host/chip layout restores
+    into any other — including flat -> cluster and a cluster saved at
+    hosts=2 restored at hosts=4 with a DIFFERENT per-host chip count —
+    with a byte-identical next answer."""
+    from skyline_tpu.cluster import ClusterEngine
+    from skyline_tpu.utils.checkpoint import load_engine, save_engine
+
+    d = 4
+    cfg = EngineConfig(parallelism=2, dims=d, buffer_size=64,
+                       domain_max=1.0, emit_skyline_points=True)
+    x = gen_points(rng, 400, d, "uniform")
+    eng = ClusterEngine(cfg, hosts=2, chips_per_host=2)
+    _run_engine(eng, x, trigger=False)
+    eng.pset.flush_all()
+    base = merge_state(eng.pset)
+    path = str(tmp_path / "ckpt.npz")
+    save_engine(eng, path)
+    # cluster checkpoint -> flat single-host engine
+    flat = load_engine(path)
+    assert not isinstance(flat, ClusterEngine)
+    assert_same_merge(base, merge_state(flat.pset), ctx="cluster->flat")
+    # cluster checkpoint -> more hosts, different per-host chip count
+    wide = load_engine(path, cluster_hosts=4, mesh_chips=1)
+    assert isinstance(wide, ClusterEngine)
+    assert wide.cluster_hosts == 4 and wide.chips_per_host == 1
+    assert_same_merge(base, merge_state(wide.pset), ctx="cluster->4hosts")
+    assert flat.records_in == wide.records_in == eng.records_in
+    # and the reverse direction: a FLAT checkpoint boots a cluster
+    flat_path = str(tmp_path / "flat.npz")
+    save_engine(flat, flat_path)
+    clustered = load_engine(flat_path, cluster_hosts=2, mesh_chips=2)
+    assert isinstance(clustered, ClusterEngine)
+    assert_same_merge(
+        base, merge_state(clustered.pset), ctx="flat->cluster"
+    )
+
+
 # --------------------------------------------------------------------------
 # chip WAL plane
 # --------------------------------------------------------------------------
