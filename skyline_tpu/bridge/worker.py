@@ -10,8 +10,10 @@ and produces one JSON result per completed query on the output topic
 from __future__ import annotations
 
 import os
+import socket
 import sys
 import time
+import uuid
 
 from skyline_tpu.bridge.wire import format_result, parse_tuple_lines
 from skyline_tpu.resilience.faults import fault_point, install_from_env
@@ -305,9 +307,15 @@ class SkylineWorker:
                 )
 
                 self._lease_plane = LeasePlane(self._wal_dir)
+                # globally unique holder id: pid alone collides across
+                # containers (pid 1) or hosts sharing the WAL dir, and
+                # LeasePlane.acquire treats a same-named holder as self —
+                # a collision would depose a live primary instead of
+                # refusing to start
                 self._lease_keeper = LeaseKeeper(
                     self._lease_plane,
-                    f"worker-{os.getpid()}",
+                    f"worker-{socket.gethostname()}-{os.getpid()}"
+                    f"-{uuid.uuid4().hex[:8]}",
                     telemetry=self.telemetry,
                 )
                 if self._lease_keeper.acquire() is None:

@@ -648,6 +648,12 @@ class ClusterPartitionSet:
             grp.restore_all(skies, pendings)
             self._members[hst] = grp
             self._member_chips[hst] = target_chips
+            # the drain folded this group's pending rows into its skylines;
+            # the facade-global cadence inputs must agree with the member or
+            # the next maybe_flush fires early — a flush-cadence deviation
+            # the byte contract counts as observable
+            for i, pd in enumerate(pendings):
+                self._pending_rows[hst * self.group_size + i] = pd.shape[0]
         grp.attach_observability(profiler=self._profiler, flight=self._flight)
         self._gm_cache = None
         # the source member is unroutable the instant the swap lands; the
@@ -743,6 +749,11 @@ class ClusterPartitionSet:
             grp.restore_all(skies, pendings)
             self._members[hst] = grp
             self._member_chips[hst] = target_chips
+            # facade-global pending bookkeeping tracks the restored slice
+            # (checkpoint_slice drains first, so these are zeros), not the
+            # replaced member's stale counts
+            for i, pd in enumerate(pendings):
+                self._pending_rows[hst * self.group_size + i] = pd.shape[0]
         grp.attach_observability(profiler=self._profiler, flight=self._flight)
         self._gm_cache = None
         self.fenced_sources += 1

@@ -41,7 +41,7 @@ import threading
 import time
 
 from skyline_tpu.resilience.faults import fault_point
-from skyline_tpu.resilience.wal import WalError, WalWriter
+from skyline_tpu.resilience.wal import WalError, WalWriter, list_segments
 
 LEASE_FILE = "lease.json"
 FENCE_FILE = "fence.json"
@@ -93,8 +93,11 @@ class LeasePlane:
         os.makedirs(wal_dir, exist_ok=True)
         self.clock = clock if clock is not None else _now_ms
         self._lock = threading.Lock()
-        # (st_mtime_ns, st_size) -> parsed fence epoch, so the per-append
-        # fence check is one stat, not one parse
+        # (st_ino, st_mtime_ns, st_size) -> parsed fence epoch, so the
+        # per-append fence check is one stat, not one parse. st_ino is
+        # load-bearing: os.replace lands a new inode every raise, so two
+        # same-size fence docs inside one mtime granule (coarse-timestamp
+        # filesystems) still invalidate the cache
         self._fence_sig = None
         self._fence_epoch = 0
 
@@ -181,7 +184,7 @@ class LeasePlane:
             st = os.stat(path)
         except OSError:
             return 0
-        sig = (st.st_mtime_ns, st.st_size)
+        sig = (st.st_ino, st.st_mtime_ns, st.st_size)
         if sig == self._fence_sig:
             return self._fence_epoch
         try:
@@ -195,11 +198,36 @@ class LeasePlane:
     def raise_fence(self, min_epoch: int) -> int:
         """Monotonically raise the fence to ``min_epoch`` (never lowers).
         After this returns, any writer below ``min_epoch`` gets
-        ``WalFencedError`` on its next append."""
+        ``WalFencedError`` on its next append.
+
+        The fence doc also records the durable CUT — newest segment seq +
+        its byte size at raise time. Everything durable before the cut is
+        the legitimate history the promoted head drains; a deposed
+        writer's frame that raced the check-then-write window necessarily
+        lands at/past the cut with a below-fence epoch, and every reader
+        (tailer, replay) skips it. That closes the race the writer-side
+        check alone cannot: a primary paused between its fence check and
+        its ``os.write`` can still land a frame, but no reader will ever
+        fold it."""
         with self._lock:
             cur = self.read_fence()
             if min_epoch > cur:
-                self._write_json(FENCE_FILE, {"min_epoch": int(min_epoch)})
+                segs = list_segments(self.wal_dir)
+                cut_seq, cut_pos = 0, 0
+                if segs:
+                    cut_seq = segs[-1][0]
+                    try:
+                        cut_pos = os.path.getsize(segs[-1][1])
+                    except OSError:
+                        cut_pos = 0
+                self._write_json(
+                    FENCE_FILE,
+                    {
+                        "min_epoch": int(min_epoch),
+                        "cut_seq": int(cut_seq),
+                        "cut_pos": int(cut_pos),
+                    },
+                )
                 self._fence_sig = None  # force a re-read next check
             return max(cur, min_epoch)
 
@@ -217,9 +245,12 @@ class LeasePlane:
 class FencedWalWriter(WalWriter):
     """A ``WalWriter`` bound to a lease epoch: every frame carries the
     fencing token, and appends from a fenced epoch are rejected BEFORE
-    the write syscall. ``barrier()`` is covered too (it appends through
-    ``append``), so a deposed primary cannot even stamp a checkpoint
-    barrier."""
+    the write syscall — plus re-checked AFTER it, so an append that
+    raced a fence raise is reported rejected rather than silently
+    trusted (readers enforce the same verdict via the fence cut).
+    ``barrier()`` is covered too, with its check before the segment
+    rotation, so a deposed primary can neither stamp a checkpoint
+    barrier nor truncate the promoted writer's fresh segment."""
 
     def __init__(
         self,
@@ -234,7 +265,7 @@ class FencedWalWriter(WalWriter):
         self.fenced_writes = 0
         super().__init__(directory, **kw)
 
-    def append(self, rec: dict) -> None:
+    def _check_fence(self) -> None:
         fence = self.plane.read_fence()
         if fence > self.epoch:
             self.fenced_writes += 1
@@ -245,10 +276,37 @@ class FencedWalWriter(WalWriter):
                 f"append rejected: writer epoch {self.epoch} is behind "
                 f"fence {fence} (another primary was promoted)"
             )
+
+    def append(self, rec: dict) -> None:
+        self._check_fence()
         if "fence" not in rec:
             rec = dict(rec)
             rec["fence"] = self.epoch
         super().append(rec)
+        # re-check AFTER the write: a fence raised inside the
+        # check-then-write window means this frame sits at/past the
+        # fence's durable cut, so every reader skips it — report the
+        # append as rejected, not silently lost. (If the frame landed
+        # just BEFORE the cut it is legitimate drained history; treating
+        # an applied write as failed is the safe side of that ambiguity —
+        # the deposed caller demotes and re-bootstraps from the WAL.)
+        fence = self.plane.read_fence()
+        if fence > self.epoch:
+            self.fenced_writes += 1
+            if self._telemetry is not None:
+                self._telemetry.inc("cluster.fenced_writes")
+            raise WalFencedError(
+                f"append raced a fence raise: writer epoch {self.epoch} is "
+                f"behind fence {fence}; readers will not fold frames past "
+                "the fence cut"
+            )
+
+    def barrier(self, rec: dict) -> None:
+        # check BEFORE rotating: ``barrier`` opens segment seq+1 with
+        # O_TRUNC first, which after a promotion can be the NEW primary's
+        # live segment — a deposed writer must be stopped before that
+        self._check_fence()
+        super().barrier(rec)
 
     def stats(self) -> dict:
         out = super().stats()
@@ -360,9 +418,21 @@ class ClusterSupervisor:
             rec = self.plane.read_lease()
             mine = self._promoted()
             if rec is not None and not rec.expired(now):
-                if mine is not None and rec.holder == mine.replica_id:
+                if mine is None or rec.holder != mine.replica_id:
+                    return None  # someone else's live lease: not ours to touch
+                try:
                     self.plane.renew(rec)
-                return None
+                    return None
+                except LeaseLostError:
+                    # another supervisor fenced past our promotee: demote
+                    # the zombie primary and fall through to re-promotion
+                    # under a higher epoch instead of crashing the
+                    # caller's timer loop
+                    demote = getattr(mine, "demote", None)
+                    if demote is not None:
+                        demote()
+                    if self.telemetry is not None:
+                        self.telemetry.inc("cluster.renewals_lost")
             # lease absent or expired: the write path is ownerless
             fault_point("cluster.lease_expire")
             t0 = time.perf_counter_ns()

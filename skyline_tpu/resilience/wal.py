@@ -27,6 +27,16 @@ not against power loss).
 
 The reader tolerates a torn tail: replay stops cleanly at the first short
 or CRC-mismatching frame and reports how many segments were cut short.
+
+Fencing (cluster mode): when a ``fence.json`` sits beside the segments
+(written by ``cluster/lease.py``), readers enforce it too. The fence doc
+records the durable byte position at the moment the fence was raised
+(``cut_seq``/``cut_pos``); any frame AT OR PAST that cut whose stamped
+epoch (``rec["fence"]``) is below ``min_epoch`` is a deposed primary's
+append that raced the fence check (check-then-write window) and is
+SKIPPED — loudly counted, never folded — by both the tailer and replay.
+Frames before the cut are the legitimate pre-fence history the promoted
+head drained, whatever their epoch.
 """
 
 from __future__ import annotations
@@ -46,6 +56,7 @@ _SEGMENT_MAGIC = b"SKWL1\n"
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 _SEGMENT_FMT = "wal-%08d.log"
 _ACK_FMT = "tail-%s.ack"
+_FENCE_FILE = "fence.json"  # written by cluster/lease.py beside the segments
 FSYNC_POLICIES = ("always", "batch", "off")
 
 
@@ -154,6 +165,56 @@ def tail_retention_floor(directory: str, ttl_s: float | None = None) -> int | No
         if floor is None or need < floor:
             floor = need
     return floor
+
+
+class _FenceView:
+    """Read-side view of ``fence.json``: ``(min_epoch, cut_seq, cut_pos)``
+    or ``None`` when the directory is unfenced (non-cluster mode — the
+    common case costs one failing ``os.stat``). Stat-cached like the
+    writer's fence check; the signature includes ``st_ino`` because
+    ``os.replace`` always lands a new inode, so two same-size fence docs
+    inside one mtime granule still invalidate the cache."""
+
+    __slots__ = ("path", "_sig", "_doc")
+
+    def __init__(self, directory: str):
+        self.path = os.path.join(directory, _FENCE_FILE)
+        self._sig = None
+        self._doc: tuple[int, int, int] | None = None
+
+    def read(self) -> tuple[int, int, int] | None:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return None
+        sig = (st.st_ino, st.st_mtime_ns, st.st_size)
+        if sig == self._sig:
+            return self._doc
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                doc = json.load(f)
+            parsed = (
+                int(doc["min_epoch"]),
+                int(doc.get("cut_seq", 0)),
+                int(doc.get("cut_pos", 0)),
+            )
+        except (OSError, ValueError, KeyError):
+            return self._doc  # torn mid-replace: keep the last good view
+        self._sig, self._doc = sig, parsed
+        return parsed
+
+
+def _frame_is_stale(
+    fence: tuple[int, int, int] | None, seq: int, pos: int, rec: dict
+) -> bool:
+    """A deposed primary's post-fence frame: located at/past the fence's
+    durable cut, stamped with an epoch below ``min_epoch`` (frames with
+    no stamp count as epoch 0 — in a fenced directory every legitimate
+    writer stamps)."""
+    if fence is None:
+        return False
+    min_epoch, cut_seq, cut_pos = fence
+    return (seq, pos) >= (cut_seq, cut_pos) and int(rec.get("fence", 0)) < min_epoch
 
 
 class WalWriter:
@@ -284,10 +345,14 @@ def read_records(directory: str) -> tuple[list[dict], int]:
     short frame, or CRC mismatch. Reading stops entirely at the first
     tear — records physically after a tear are not trustworthy in
     sequence (only the final segment of a crashed run can legitimately
-    be torn, and it is by definition last)."""
+    be torn, and it is by definition last). In a fenced directory,
+    post-cut frames from a deposed epoch are skipped (see the module
+    docstring) so replay agrees with the promoted head's history."""
     records: list[dict] = []
     torn = 0
-    for _seq, path in list_segments(directory):
+    stale = 0
+    fence = _FenceView(directory).read()
+    for seq, path in list_segments(directory):
         with open(path, "rb") as f:
             data = f.read()
         if data[: len(_SEGMENT_MAGIC)] != _SEGMENT_MAGIC:
@@ -306,14 +371,24 @@ def read_records(directory: str) -> tuple[list[dict], int]:
                 ok = False
                 break
             try:
-                records.append(json.loads(payload.decode("utf-8")))
+                rec = json.loads(payload.decode("utf-8"))
             except ValueError:
                 ok = False
                 break
+            if _frame_is_stale(fence, seq, pos, rec):
+                stale += 1
+            else:
+                records.append(rec)
             pos = start + length
         if not ok:
             torn += 1
             break
+    if stale:
+        print(
+            f"wal: replay skipped {stale} stale post-fence frame(s) from a "
+            "deposed writer epoch",
+            file=sys.stderr,
+        )
     return records, torn
 
 
@@ -361,7 +436,12 @@ class WalTailer:
     Registration: the tailer drops ``tail-<id>.ack`` (atomic
     ``os.replace``) recording the highest segment it has fully consumed;
     ``WalWriter.barrier()`` retains anything past that floor. ``close()``
-    withdraws the registration."""
+    withdraws the registration.
+
+    Fencing: in a fenced directory the tailer enforces the fence on read
+    — frames at/past the fence's durable cut with a deposed epoch are
+    skipped and counted (``stale_frames_skipped``), never folded, so
+    every tailer agrees byte-for-byte with the promoted head."""
 
     def __init__(self, directory: str, tailer_id: str):
         self.directory = directory
@@ -372,6 +452,9 @@ class WalTailer:
         self.frames_read = 0
         self.segments_finished = 0
         self.partial_retries = 0
+        self.stale_frames_skipped = 0
+        self._fence = _FenceView(directory)
+        self._cur_fence: tuple[int, int, int] | None = None
         self._ack(-1)  # register before reading: pins retention from t0
 
     def _ack(self, seq: int) -> None:
@@ -430,6 +513,11 @@ class WalTailer:
                         f"segment {self._seq} pruned mid-read at {self._pos}"
                     )
                 break  # directory empty/young: nothing to read yet
+            # fence view AFTER the data read: a stale frame can only land
+            # after the fence doc (and its durable cut) hit the disk, so
+            # any such frame in ``data`` is guaranteed visible to this
+            # fence read — no ordering window
+            self._cur_fence = self._fence.read()
             n, complete = self._scan(data, later_exists=bool(later), out=out)
             if not complete:
                 break  # holding at a live tail
@@ -476,10 +564,16 @@ class WalTailer:
                 raise WalTailCorruption(
                     f"segment {self._seq} @ {self._pos}: bad JSON ({e})"
                 ) from None
-            out.append(rec)
+            if _frame_is_stale(self._cur_fence, self._seq, self._pos, rec):
+                # a deposed primary's append raced the fence raise: the
+                # promoted head's drain excluded it, so folding it here
+                # would silently diverge every tailer from the primary
+                self.stale_frames_skipped += 1
+            else:
+                out.append(rec)
+                frames += 1
+                self.frames_read += 1
             self._pos = start + length
-            frames += 1
-            self.frames_read += 1
         if self._pos >= len(data):
             return frames, later_exists  # fully parsed; done iff rotated away
         if later_exists:
@@ -496,6 +590,7 @@ class WalTailer:
             "frames_read": self.frames_read,
             "segments_finished": self.segments_finished,
             "partial_retries": self.partial_retries,
+            "stale_frames_skipped": self.stale_frames_skipped,
         }
 
     def close(self) -> None:

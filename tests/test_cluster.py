@@ -20,6 +20,9 @@ Acceptance bars:
 
 from __future__ import annotations
 
+import os
+from types import SimpleNamespace
+
 import numpy as np
 import pytest
 
@@ -40,7 +43,7 @@ from skyline_tpu.resilience.faults import (
     clear,
     install_plan,
 )
-from skyline_tpu.resilience.wal import WalWriter, read_records
+from skyline_tpu.resilience.wal import WalTailer, WalWriter, read_records
 from skyline_tpu.serve import (
     SnapshotStore,
     delta_wal_record,
@@ -194,6 +197,152 @@ def test_stale_fence_fault_verb_fires(tmp_path):
     with pytest.raises(WalFencedError):
         w.append({"type": "delta", "i": 0})
     w.close()
+
+
+def test_raced_post_fence_frame_skipped_by_every_reader(tmp_path):
+    """The check-then-write race: a deposed primary paused between its
+    fence check and its ``os.write`` lands a stale-epoch frame AFTER the
+    fence (and its durable cut) hit the disk. No reader may fold it —
+    the promoted head's drain excluded it, so folding would silently
+    diverge every tailer from the primary."""
+    d = str(tmp_path)
+    plane = LeasePlane(d)
+    rec = plane.acquire("primary-0", ttl_ms=1000.0)
+    w = FencedWalWriter(d, rec.epoch, plane=plane, fsync="off")
+    w.append({"type": "delta", "i": 0})  # legitimate pre-fence history
+    LeasePlane(d).raise_fence(rec.epoch + 1)  # a supervisor fences us
+    # the race, made deterministic: bypass the fenced checks exactly the
+    # way a paused-then-resumed writer's os.write does
+    WalWriter.append(w, {"type": "delta", "i": 1, "fence": rec.epoch})
+    # the promoted primary appends under the new epoch (fresh segment)
+    w2 = FencedWalWriter(d, rec.epoch + 1, plane=LeasePlane(d), fsync="off")
+    w2.append({"type": "delta", "i": 2})
+    # replay: the stale frame is skipped, everything else kept in order
+    recs, torn = read_records(d)
+    assert torn == 0
+    assert [r["i"] for r in recs if r["type"] == "delta"] == [0, 2]
+    # live tailer: same verdict, loudly counted
+    t = WalTailer(d, "t0")
+    got = t.poll()
+    assert [r["i"] for r in got if r["type"] == "delta"] == [0, 2]
+    assert t.stats()["stale_frames_skipped"] == 1
+    t.close()
+    w.close()
+    w2.close()
+
+
+def test_append_racing_fence_raise_is_reported_rejected(tmp_path):
+    """Writer-side half of the race: the post-write re-check turns a
+    frame that landed inside the check-then-write window into a loud
+    ``WalFencedError`` instead of a silently-trusted success."""
+    d = str(tmp_path)
+    plane = LeasePlane(d)
+    rec = plane.acquire("primary-0", ttl_ms=1000.0)
+    w = FencedWalWriter(d, rec.epoch, plane=plane, fsync="off")
+    w.append({"type": "delta", "i": 0})
+    LeasePlane(d).raise_fence(rec.epoch + 1)
+    # freeze the PRE-check's fence view at the stale epoch for one call —
+    # the moral equivalent of being descheduled between check and write
+    real = plane.read_fence
+    state = {"calls": 0}
+
+    def stale_once():
+        state["calls"] += 1
+        return 0 if state["calls"] == 1 else real()
+
+    plane.read_fence = stale_once
+    try:
+        with pytest.raises(WalFencedError, match="raced"):
+            w.append({"type": "delta", "i": 1})
+    finally:
+        del plane.read_fence
+    assert w.fenced_writes == 1
+    # the frame physically landed, but no reader folds it
+    recs, _ = read_records(d)
+    assert [r["i"] for r in recs if r["type"] == "delta"] == [0]
+    w.close()
+
+
+def test_fenced_barrier_rejected_before_segment_rotation(tmp_path):
+    """A deposed primary's ``barrier()`` must be rejected BEFORE it
+    rotates: the rotation O_TRUNCs segment seq+1, which after a
+    promotion is the new primary's live segment."""
+    d = str(tmp_path)
+    plane = LeasePlane(d)
+    rec = plane.acquire("primary-0", ttl_ms=1000.0)
+    w = FencedWalWriter(d, rec.epoch, plane=plane, fsync="off")
+    w.append({"type": "delta", "i": 0})
+    LeasePlane(d).raise_fence(rec.epoch + 1)
+    w2 = FencedWalWriter(d, rec.epoch + 1, plane=LeasePlane(d), fsync="off")
+    w2.append({"type": "delta", "i": 2})
+    seg2_path = os.path.join(d, "wal-%08d.log" % w2.stats()["segment_seq"])
+    seg2_size = os.path.getsize(seg2_path)
+    with pytest.raises(WalFencedError):
+        w.barrier({"type": "ckpt"})
+    # the promoted writer's on-disk segment was not clobbered by the
+    # deposed writer's rotation
+    assert os.path.getsize(seg2_path) == seg2_size
+    recs, _ = read_records(d)
+    assert [r["i"] for r in recs if r["type"] == "delta"] == [0, 2]
+    w.close()
+    w2.close()
+
+
+def test_fence_cache_sees_same_size_same_mtime_raise(tmp_path):
+    """Two raises producing same-size JSON within one mtime granule must
+    still be observed: ``os.replace`` lands a new inode every raise and
+    ``st_ino`` is part of the stat-cache signature."""
+    d = str(tmp_path)
+    reader = LeasePlane(d)  # a writer's cached view of the fence
+    fence_path = str(tmp_path / "fence.json")
+    LeasePlane(d).raise_fence(3)
+    os.utime(fence_path, ns=(1, 1))
+    assert reader.read_fence() == 3  # primes the stat cache
+    size_before = os.path.getsize(fence_path)
+    LeasePlane(d).raise_fence(5)
+    os.utime(fence_path, ns=(1, 1))  # coarse-timestamp filesystem
+    assert os.path.getsize(fence_path) == size_before  # same signature sans inode
+    assert reader.read_fence() == 5
+
+
+class _StubReplica:
+    """The supervisor-facing replica surface, without a WAL."""
+
+    def __init__(self, rid: str, head: int):
+        self.replica_id = rid
+        self.role = "replica"
+        self.store = SimpleNamespace(head_version=head)
+
+    def promote(self, epoch: int) -> dict:
+        self.role = "primary"
+        return {"head_version": self.store.head_version, "head_digest": None}
+
+    def demote(self) -> None:
+        self.role = "replica"
+
+
+def test_supervisor_tick_survives_rival_fence(tmp_path):
+    """A rival supervisor fencing past our promotee must not crash
+    ``tick()``: the renew-on-behalf ``LeaseLostError`` demotes the
+    zombie primary and falls through to re-promotion under a higher
+    epoch, instead of blowing up the caller's timer loop."""
+    clock = {"now": 0.0}
+    r0, r1 = _StubReplica("r0", 5), _StubReplica("r1", 3)
+    sup = ClusterSupervisor(
+        str(tmp_path), [r0, r1], lease_ttl_ms=500.0,
+        clock=lambda: clock["now"],
+    )
+    doc = sup.tick()  # no lease on disk: promote immediately
+    assert doc is not None and doc["holder"] == "r0"
+    assert r0.role == "primary"
+    # the rival fences past our promotee between our ticks
+    LeasePlane(str(tmp_path)).raise_fence(doc["epoch"] + 1)
+    clock["now"] = 100.0  # lease still live: this tick takes the renew path
+    doc2 = sup.tick()  # must NOT raise LeaseLostError
+    assert doc2 is not None
+    assert doc2["epoch"] > doc["epoch"] + 1, "re-promoted past the rival fence"
+    assert sup.promotions == 2
+    assert sorted(r.role for r in (r0, r1)) == ["primary", "replica"]
 
 
 def test_lease_keeper_renews_on_cadence(tmp_path):
@@ -442,6 +591,26 @@ def test_migrate_rebuilds_member_at_different_chip_count(rng):
     _feed_pset(cp, y)
     assert_same_merge(merge_state(flat), merge_state(cp), ctx="post-ingest")
     assert cp.cluster_stats()["migrations"] == 1
+
+
+def test_migration_drains_facade_pending_bookkeeping(rng):
+    """``migrate()`` drains the member's pending rows into its skylines;
+    the facade-global ``_pending_rows`` slice must drain with it or
+    ``pending_rows_total`` overcounts and the next ``maybe_flush`` fires
+    early — a flush-cadence deviation the byte contract forbids."""
+    d = 2
+    cp = ClusterPartitionSet(P, d, 64, hosts=2)
+    rows = gen_points(rng, 96, d, "uniform")
+    for p in range(P):
+        cp.add_batch(p, rows[p * 12:(p + 1) * 12], max_id=100, now_ms=0.0)
+    assert cp.pending_rows_total == 96
+    cp.migrate(1)
+    G = cp.group_size
+    # host 1's 48 rows are folded into its skylines by the drain; host 0
+    # is untouched
+    assert int(cp._pending_rows[G:].sum()) == 0
+    assert int(cp._pending_rows[:G].sum()) == 48
+    assert cp.pending_rows_total == 48
 
 
 def test_migration_budget_exhausts(rng, monkeypatch):
