@@ -730,6 +730,61 @@ def cluster_leg(d: int) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def ops_leg(d: int) -> dict:
+    """Ops-plane stamp (ISSUE 17): the cost of watching the cluster.
+
+    Three numbers: the per-record append cost of the durable ops journal
+    (one CRC-framed ``os.write`` per control-plane transition — this is
+    the overhead every lease renewal and fence raise pays), the wall to
+    re-read and merge the journal, and the clusterview scrape wall
+    against one real member over loopback HTTP (journal tail + /metrics
+    + /cluster + /healthz folded into the ``/cluster/overview`` doc).
+    The identity-asserting on/off A/B lives in ``benchmarks/opslog.py``
+    (artifacts/opslog_ab.json); the replication-lag quantiles the
+    sentinel gates are restated from the replica leg by ``child_main``.
+    """
+    import shutil
+    import tempfile
+
+    from skyline_tpu.metrics.httpstats import StatsServer
+    from skyline_tpu.telemetry import Telemetry
+    from skyline_tpu.telemetry.clusterview import ClusterView
+    from skyline_tpu.telemetry.opslog import OpsLog, read_ops
+
+    appends = env_int("BENCH_OPS_APPENDS", 2000)
+    tmp = tempfile.mkdtemp(prefix="bench-ops-")
+    srv = ops = None
+    try:
+        ops = OpsLog(tmp, fsync="off")
+        t0 = time.perf_counter()
+        for i in range(appends):
+            ops.record("lease_acquired", epoch=i, fence=i, holder="bench")
+        append_us = (time.perf_counter() - t0) / max(1, appends) * 1e6
+        ops.flush(force=True)
+        t0 = time.perf_counter()
+        doc = read_ops(tmp)
+        read_wall_ms = (time.perf_counter() - t0) * 1e3
+        hub = Telemetry()
+        hub.opslog = ops
+        srv = StatsServer(lambda: {"ok": True}, port=0, telemetry=hub)
+        view = ClusterView([f"http://127.0.0.1:{srv.port}"])
+        overview = view.overview()
+        return {
+            "journal_append_us": round(append_us, 2),
+            "journal_records": doc["total"],
+            "journal_read_wall_ms": round(read_wall_ms, 2),
+            "scrape_wall_ms": overview.get("scrape_wall_ms"),
+            "scrape_ok": bool(overview["members"][0]["ok"]),
+            "findings": len(overview["findings"]),
+        }
+    finally:
+        if srv is not None:
+            srv.close()
+        if ops is not None:
+            ops.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def child_main(backend: str) -> None:
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -859,6 +914,24 @@ def child_main(backend: str) -> None:
             cluster = {"error": f"{type(e).__name__}: {e}"}
     else:
         cluster = {"skipped": True}
+    # ops-plane leg: journal append cost + clusterview scrape wall
+    # (BENCH_OPS=0 to skip)
+    if env_bool("BENCH_OPS", True):
+        try:
+            ops = ops_leg(d)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            ops = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        ops = {"skipped": True}
+    # replication lag for the ops-plane sentinel/gate: the replica leg's
+    # real tail-lag quantiles, restated under the blocks whose dotted
+    # paths the watchers resolve (cluster.replication_lag_p99_ms)
+    if isinstance(replica, dict) and replica.get("read_lag_p99_ms") is not None:
+        if isinstance(cluster, dict):
+            cluster["replication_lag_p99_ms"] = replica["read_lag_p99_ms"]
+        if isinstance(ops, dict):
+            ops["replication_lag_p50_ms"] = replica.get("read_lag_p50_ms")
+            ops["replication_lag_p99_ms"] = replica["read_lag_p99_ms"]
     # lineage + kernel registry ride the artifact as top-level blocks so
     # scripts/bench_compare.py can gate on freshness.read_lag_p99_ms
     freshness = serve.pop("freshness", {"skipped": True})
@@ -938,6 +1011,7 @@ def child_main(backend: str) -> None:
                 "serve": serve,
                 "replica": replica,
                 "cluster": cluster,
+                "ops": ops,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,

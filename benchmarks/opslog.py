@@ -1,0 +1,183 @@
+"""A/B: the ops journal's cost on the publish path (ISSUE 17).
+
+Feeds IDENTICAL publish streams through the primary -> WAL -> tailing
+replica pipeline twice — ops plane OFF (``SKYLINE_OPSLOG=0``, no
+journal anywhere) and ON (a journal attached to the replica AND
+appended to on EVERY publish — a deliberate worst case: the real plane
+only records control-plane transitions, which are orders of magnitude
+rarer than publishes) — and asserts the published skyline bytes and the
+replica's folded head are byte-identical across the two runs BEFORE any
+timing. Observability that changes the answer is a bug, not a feature.
+
+Then reports the honest overhead: publish wall on vs off, and the raw
+per-record journal append cost in µs at ``fsync=off`` (the default
+batch discipline) and ``fsync=always`` (the paranoid bound).
+
+Writes ``artifacts/opslog_ab.json``.
+
+Usage: python benchmarks/opslog.py [--publishes 40] [--rows 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_pipeline(tmp: str, d: int, n_pub: int, rows: int,
+                 ops_on: bool) -> dict:
+    """One full primary->WAL->replica run; returns the published bytes,
+    the replica's folded bytes, the publish wall, and journal stats."""
+    from skyline_tpu.resilience.wal import WalWriter
+    from skyline_tpu.serve import SnapshotStore, delta_wal_record
+    from skyline_tpu.serve.replica import SkylineReplica
+    from skyline_tpu.telemetry.opslog import OpsLog
+
+    writer = ops = replica = None
+    try:
+        writer = WalWriter(tmp, fsync="off")
+        if ops_on:
+            ops = OpsLog(tmp, fsync="off")
+        store = SnapshotStore()
+
+        def shadow(prev, snap):
+            writer.append(delta_wal_record(prev, snap))
+            writer.flush(force=True)
+            if ops is not None:  # worst case: one journal record/publish
+                ops.record(
+                    "degraded_publish", epoch=0, version=snap.version
+                )
+
+        store.on_publish(shadow)
+        replica = SkylineReplica(
+            tmp, replica_id="ab", poll_interval_s=0.001, opslog=ops
+        )
+        rng = np.random.default_rng(11)
+        t0 = time.perf_counter()
+        for _ in range(n_pub):
+            store.publish(rng.random((rows, d), dtype=np.float32))
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        converged = replica.wait_for_version(
+            store.head_version, timeout_s=30.0
+        )
+        assert converged, "replica never converged"
+        return {
+            "published_bytes": store.latest().points.tobytes(),
+            "replica_bytes": replica.store.latest().points.tobytes(),
+            "head_version": store.head_version,
+            "publish_wall_ms": round(wall_ms, 2),
+            "ops_stats": ops.stats() if ops is not None else None,
+        }
+    finally:
+        if replica is not None:
+            replica.close()
+        if ops is not None:
+            ops.close()
+        if writer is not None:
+            writer.close()
+
+
+def bench_append(tmp: str, appends: int, fsync: str) -> float:
+    """Raw per-record journal append cost in µs at the given discipline."""
+    from skyline_tpu.telemetry.opslog import OpsLog
+
+    ops = OpsLog(tmp, fsync=fsync)
+    try:
+        t0 = time.perf_counter()
+        for i in range(appends):
+            ops.record("lease_acquired", epoch=i, fence=i, holder="ab")
+        return (time.perf_counter() - t0) / max(1, appends) * 1e6
+    finally:
+        ops.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--publishes", type=int, default=40)
+    ap.add_argument("--rows", type=int, default=2048)
+    ap.add_argument("--dims", type=int, default=8)
+    ap.add_argument("--appends", type=int, default=2000)
+    ap.add_argument("--out", default="artifacts/opslog_ab.json")
+    a = ap.parse_args(argv)
+
+    prev = os.environ.get("SKYLINE_OPSLOG")  # lint: allow-raw-env
+    try:
+        legs = {}
+        for label, on in (("off", False), ("on", True)):
+            os.environ["SKYLINE_OPSLOG"] = "1" if on else "0"
+            tmp = tempfile.mkdtemp(prefix=f"opslog-ab-{label}-")
+            try:
+                legs[label] = run_pipeline(
+                    tmp, a.dims, a.publishes, a.rows, ops_on=on
+                )
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        # byte-identity BEFORE any number is reported: the plane must not
+        # perturb the data plane
+        assert (
+            legs["on"]["published_bytes"] == legs["off"]["published_bytes"]
+        ), "ops plane changed the published skyline bytes"
+        assert (
+            legs["on"]["replica_bytes"] == legs["off"]["replica_bytes"]
+        ), "ops plane changed the replica's folded bytes"
+        assert (
+            legs["on"]["head_version"] == legs["off"]["head_version"]
+        ), "ops plane changed the head version"
+
+        tmp = tempfile.mkdtemp(prefix="opslog-append-")
+        try:
+            append_off_us = bench_append(
+                os.path.join(tmp, "off"), a.appends, "off"
+            )
+            append_always_us = bench_append(
+                os.path.join(tmp, "always"), a.appends, "always"
+            )
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+        off_ms = legs["off"]["publish_wall_ms"]
+        on_ms = legs["on"]["publish_wall_ms"]
+        results = {
+            "publishes": a.publishes,
+            "rows_per_snapshot": a.rows,
+            "dims": a.dims,
+            "byte_identical": True,  # asserted above, recorded for readers
+            "head_version": legs["on"]["head_version"],
+            "publish_wall_off_ms": off_ms,
+            "publish_wall_on_ms": on_ms,
+            "overhead_fraction": (
+                round((on_ms - off_ms) / off_ms, 4) if off_ms else None
+            ),
+            "journal_append_us": round(append_off_us, 2),
+            "journal_append_fsync_us": round(append_always_us, 2),
+            "ops_stats": {
+                k: v
+                for k, v in (legs["on"]["ops_stats"] or {}).items()
+                if k != "path"
+            },
+        }
+        print(json.dumps(results), flush=True)
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLINE_OPSLOG", None)
+        else:
+            os.environ["SKYLINE_OPSLOG"] = prev
+    if a.out:
+        os.makedirs(os.path.dirname(a.out) or ".", exist_ok=True)
+        with open(a.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,7 @@ non-zero when any regresses past ``--threshold`` (default 25%):
   serve.read_p99_ms      serve read p99    higher is a regression
   merge_cache.hit_rate   merge-cache leg   lower is a regression
   flush_cascade.prefilter_drop_fraction    lower is a regression
+  cluster.replication_lag_p99_ms           higher is a regression
   audit.divergence_total shadow checks     ABSOLUTE: any divergence in
                                            the NEW artifact fails
   failover.healthy_degraded                ABSOLUTE: any degraded answer
@@ -114,6 +115,12 @@ METRICS = (
     # partitioner started funneling rows to few chips (lower = balanced,
     # 1.0 = perfect). Absent on pre-fleet artifacts -> skipped
     ("fleet.imbalance_index", ("fleet", "imbalance_index"), False, False),
+    # ops plane (ISSUE 17, bench.py replica_leg restated by child_main):
+    # replication-lag p99 creeping up means a failover would inherit that
+    # much staleness — the real tail-lag histogram of a live replica, not
+    # a drill number. Absent on pre-ops artifacts -> skipped
+    ("cluster.replication_lag_p99_ms",
+     ("cluster", "replication_lag_p99_ms"), False, False),
 )
 
 

@@ -402,7 +402,12 @@ EOF
 # head must serve byte-identical answers over HTTP, every post-fence
 # append from the deposed epoch must be rejected AT THE WAL LAYER, and
 # the deposed node must be able to rejoin as a demoted follower that
-# reconverges through the tail at the NEW epoch
+# reconverges through the tail at the NEW epoch. Since ISSUE 17 the
+# drill also proves the OPS JOURNAL carries the whole story: the full
+# causal chain (lease_expired -> fence_raised -> promoted ->
+# zombie_append_rejected -> demoted) must be reconstructable IN SEQ
+# ORDER from the durable journal alone, epochs consistent throughout
+# (RUNBOOK §2s).
 JAX_PLATFORMS=cpu python - <<'EOF'
 import hashlib
 import shutil
@@ -422,6 +427,7 @@ from skyline_tpu.cluster import (
 from skyline_tpu.serve import SkylineServer, SnapshotStore, delta_wal_record
 from skyline_tpu.serve.replica import SkylineReplica
 from skyline_tpu.serve.snapshot import points_digest
+from skyline_tpu.telemetry.opslog import OpsLog, read_ops
 
 
 def get(url):
@@ -437,7 +443,10 @@ rng = np.random.default_rng(31)
 TTL_MS = 600.0
 plane = LeasePlane(wal_dir)
 lease = plane.acquire("primary-0", ttl_ms=TTL_MS)
-writer = FencedWalWriter(wal_dir, lease.epoch, plane=plane, fsync="off")
+ops = OpsLog(wal_dir, process_id="worker-drill-1", fsync="off")
+ops.record("lease_acquired", epoch=lease.epoch, holder=lease.holder)
+writer = FencedWalWriter(wal_dir, lease.epoch, plane=plane, fsync="off",
+                         opslog=ops)
 
 
 def shadow(prev, snap):
@@ -449,9 +458,9 @@ store = SnapshotStore()
 store.on_publish(shadow)
 primary = SkylineServer(store, port=0)
 rep_a = SkylineReplica(wal_dir, replica_id="rep-a",
-                       poll_interval_s=0.005, start=True)
+                       poll_interval_s=0.005, start=True, opslog=ops)
 rep_b = SkylineReplica(wal_dir, replica_id="rep-b",
-                       poll_interval_s=0.005, start=True)
+                       poll_interval_s=0.005, start=True, opslog=ops)
 writer2 = None
 try:
     # burst under a live lease, renewing on cadence like a real primary
@@ -467,7 +476,7 @@ try:
     primary.close()
     dark_t0 = time.perf_counter()
     sup = ClusterSupervisor(
-        wal_dir, [rep_a, rep_b], lease_ttl_ms=TTL_MS
+        wal_dir, [rep_a, rep_b], lease_ttl_ms=TTL_MS, opslog=ops
     )
     doc = None
     while doc is None:
@@ -536,16 +545,44 @@ try:
                 )
     finally:
         rejoin.close()
+    # ---- the whole story from the durable ops journal ALONE ----
+    # (read back from disk, not from any in-memory object: this is what
+    # an operator reconstructing the incident after the fact would see)
+    chain_types = ("lease_expired", "fence_raised", "promoted",
+                   "zombie_append_rejected", "demoted")
+    recs = read_ops(wal_dir)["records"]
+    chain = [r for r in recs if r["type"] in chain_types]
+    assert [r["type"] for r in chain] == list(chain_types), (
+        [r["type"] for r in chain]
+    )
+    seqs = [r["seq"] for r in chain]
+    assert seqs == sorted(seqs), f"causal chain out of seq order: {seqs}"
+    by = {r["type"]: r for r in chain}
+    new_epoch = doc["epoch"]
+    # epochs consistent through the chain: the dead lease expired below
+    # the fence, the fence/promotion happened AT the new epoch, and the
+    # zombie's durable confession names its stale epoch under that fence
+    assert by["lease_expired"]["epoch"] == lease.epoch < new_epoch
+    assert by["fence_raised"]["fence"] == new_epoch
+    assert "cut_seq" in by["fence_raised"], by["fence_raised"]
+    assert by["promoted"]["epoch"] == new_epoch
+    assert by["promoted"]["holder"] == doc["holder"]
+    assert by["zombie_append_rejected"]["fence"] == new_epoch
+    assert by["zombie_append_rejected"]["epoch"] == lease.epoch
+    assert by["demoted"]["replica"] == doc["holder"]
     print(f"[chaos-smoke] promotion drill ok: primary dark -> fenced + "
           f"promoted {doc['holder']} (epoch {doc['epoch']}, "
           f"promote {doc['time_to_promote_ms']:.1f}ms, dark "
           f"{dark_ms:.0f}ms) -> HTTP byte-identical -> zombie append "
-          f"rejected -> rejoined demoted, reconverged at the new epoch")
+          f"rejected -> rejoined demoted, reconverged at the new epoch; "
+          f"causal chain {'->'.join(chain_types)} reconstructed from the "
+          f"ops journal alone, seqs {seqs}")
 finally:
     rep_a.close()
     rep_b.close()
     if writer2 is not None:
         writer2.close()
     writer.close()
+    ops.close()
     shutil.rmtree(wal_dir, ignore_errors=True)
 EOF

@@ -186,11 +186,27 @@ try:
     assert set(slo["slos"]) == {"read_p99", "freshness_p99",
                                 "shed_fraction", "restart_rate",
                                 "audit_divergence", "degraded_answers",
-                                "tenant_shed_fraction"}, slo
+                                "tenant_shed_fraction",
+                                "replication_lag_p99", "promote_p99"}, slo
     for name, s in slo["slos"].items():
         assert {"fast", "slow"} <= set(s["windows"]), (name, s)
         assert s["breach"] is False, (name, s)
     print(f"[obs-smoke] /slo ok: {len(slo['slos'])} SLOs, no breach")
+
+    # ops plane (ISSUE 17): /ops and /cluster/overview answer on BOTH
+    # surfaces — probe-friendly on this flat worker (no WAL directory, no
+    # fleet membership), never a 404; the live-journal path is exercised
+    # in the replica leg below and in scripts/chaos_smoke.sh
+    for base in (stats_base, serve_base):
+        with urllib.request.urlopen(f"{base}/ops", timeout=5) as r:
+            doc = json.load(r)
+        assert doc == {"ok": True, "enabled": False}, doc
+        with urllib.request.urlopen(f"{base}/cluster/overview",
+                                    timeout=5) as r:
+            doc = json.load(r)
+        assert doc["ok"] is True and doc["enabled"] is False, doc
+    print("[obs-smoke] /ops + /cluster/overview probe-friendly on both "
+          "surfaces (plane off)")
 
     # EXPLAIN plane (ISSUE 9): every answered query left a complete plan
     # in the ring; both surfaces serve it and /skyline inlines it. The
@@ -511,6 +527,49 @@ try:
     print(f"[obs-smoke] replica surface ok: byte-identical read, "
           f"role-marked healthz, SSE delta push, {shed} tenant shed(s) "
           f"labeled on /metrics, sentinel watches read lag")
+
+    # ops plane (ISSUE 17, RUNBOOK §2s): replication telemetry as LABELED
+    # families on the live replica exposition, the durable ops journal on
+    # /ops, the fleet overview on /cluster/overview, and the sentinel row
+    # watching replication lag
+    from skyline_tpu.telemetry.clusterview import ClusterView
+    from skyline_tpu.telemetry.opslog import OpsLog
+
+    assert any(r["label"] == "cluster.replication_lag_p99_ms"
+               for r in DEFAULT_RULES), \
+        "sentinel does not watch replication lag"
+    assert 'skyline_replica_head_version{replica="obs-rep"}' in prom, \
+        "labeled replica head gauge missing from exposition"
+    assert 'skyline_replica_lag_ms{replica="obs-rep"}' in prom, \
+        "labeled replica lag gauge missing from exposition"
+    assert 'skyline_replica_records_applied_total{replica="obs-rep"}' \
+        in prom, "labeled replica applied counter missing from exposition"
+    ops = OpsLog(wal_dir, process_id="worker-obs-1", fsync="off")
+    ops.record("promoted", epoch=2, holder="obs-rep")
+    ops.flush(force=True)
+    rep.telemetry.opslog = ops
+    rep.telemetry.clusterview = ClusterView(
+        [f"http://127.0.0.1:{primary.port}",
+         f"http://127.0.0.1:{rep.port}"])
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/ops?limit=8", timeout=5
+    ) as r:
+        opsdoc = json.load(r)
+    assert opsdoc["enabled"] and opsdoc["total"] >= 1, opsdoc
+    assert any(rec["type"] == "promoted" for rec in opsdoc["records"]), \
+        opsdoc
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/cluster/overview", timeout=5
+    ) as r:
+        ov = json.load(r)
+    assert ov["enabled"] is True and ov["ok"] is True, ov
+    assert ov["fleet"]["size"] == 2 and ov["fleet"]["live"] == 2, ov
+    assert ov["findings"] == [], ov["findings"]
+    ops.close()
+    print(f"[obs-smoke] ops plane ok: labeled replica families on "
+          f"/metrics, {opsdoc['total']} journal record(s) on /ops, "
+          f"fleet overview {ov['fleet']['live']}/{ov['fleet']['size']} "
+          f"live with zero findings")
 finally:
     rep.close()
     primary.close()

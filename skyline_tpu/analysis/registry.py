@@ -474,6 +474,39 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_SLO_TENANT_SHED", "float", 0.05,
        "SLO target: max fraction of tenant-attributed read attempts shed "
        "by the per-tenant buckets", "telemetry/slo", runbook="§2q"),
+    _k("SKYLINE_SLO_REPLICATION_LAG_P99_MS", "float", 2000.0,
+       "SLO target: 99% of replica WAL-fold applications land within this "
+       "many ms of the frame's publish time (the staleness a failover "
+       "would inherit)", "telemetry/slo", runbook="§2s"),
+    _k("SKYLINE_SLO_PROMOTE_P99_MS", "float", 1000.0,
+       "SLO target: 99% of supervisor promotions (fence raise to replica "
+       "serving) complete within this many ms", "telemetry/slo",
+       runbook="§2s"),
+    _k("SKYLINE_OPSLOG", "bool", True,
+       "durable cross-process ops journal beside the WAL: control-plane "
+       "transitions (lease/fence/promote/demote/quarantine/migrate/"
+       "degraded publish) as CRC-framed records, GET /ops on both HTTP "
+       "surfaces", "telemetry/ops", runbook="§2s"),
+    _k("SKYLINE_OPSLOG_FSYNC", "enum", "off",
+       "ops-journal durability policy: 'off' relies on one unbuffered "
+       "write per record (survives process death), 'always' fsyncs every "
+       "record (power-loss durable, ~ms each), 'batch' fsyncs on flush()",
+       "telemetry/ops", choices=("always", "batch", "off"), runbook="§2s"),
+    _k("SKYLINE_OPSLOG_MAX_BYTES", "int", 8_388_608,
+       "per-incarnation ops-journal size cap; past it records are dropped "
+       "and counted (ops.dropped), never raised", "telemetry/ops",
+       runbook="§2s"),
+    _k("SKYLINE_CLUSTERVIEW_MEMBERS", "str", None,
+       "comma-separated member base URLs the fleet-wide aggregation view "
+       "scrapes for GET /cluster/overview (and the clusterview CLI "
+       "default)", "telemetry/ops", runbook="§2s"),
+    _k("SKYLINE_CLUSTERVIEW_TIMEOUT_S", "float", 2.0,
+       "per-request timeout when the clusterview scraper polls a member's "
+       "/metrics, /cluster, /healthz, /ops", "telemetry/ops",
+       runbook="§2s"),
+    _k("SKYLINE_CLUSTERVIEW_OPS_TAIL", "int", 64,
+       "ops-journal records the clusterview scraper pulls per member "
+       "(?limit= on each member's /ops)", "telemetry/ops", runbook="§2s"),
     _k("SKYLINE_FLEET", "bool", True,
        "per-chip fleet plane on the sharded engine: skyline_chip_* "
        "labeled metric families, imbalance index + skew ring, per-chip "
@@ -557,6 +590,11 @@ KNOBS: tuple[Knob, ...] = (
     _k("BENCH_CLUSTER", "bool", True,
        "run the cluster-plane bench leg (host-prune probe + promotion "
        "drill)", "bench", runbook="§2r"),
+    _k("BENCH_OPS", "bool", True,
+       "run the ops-plane bench leg (journal append cost + clusterview "
+       "scrape wall)", "bench", runbook="§2s"),
+    _k("BENCH_OPS_APPENDS", "int", 2000,
+       "ops-leg journal appends timed for the per-record cost", "bench"),
     _k("BENCH_SERVE_POINTS", "bool", False,
        "serve-leg full-payload reads instead of metadata-only", "bench"),
     _k("BENCH_COMPILE_CACHE", "str", None,

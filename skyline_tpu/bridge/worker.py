@@ -138,6 +138,7 @@ class SkylineWorker:
         self._chip_wal = None
         self._lease_plane = None
         self._lease_keeper = None
+        self._opslog = None
         self._deposed = False
         self._snap_store = None
         self._serve_ring = None
@@ -295,6 +296,19 @@ class SkylineWorker:
                 # dead replica can't pin the log forever
                 tailer_ttl_s=env_float("SKYLINE_WAL_TAILER_TTL_S", 600.0),
             )
+            # durable cross-process ops journal (RUNBOOK §2s): every
+            # control-plane transition this process performs — lease
+            # acquire, demotion, quarantine, degraded publish — lands
+            # beside the WAL so a post-mortem reconstructs the fleet's
+            # causal timeline across processes
+            from skyline_tpu.telemetry.opslog import OpsLog, opslog_enabled
+
+            if opslog_enabled():
+                self._opslog = OpsLog(self._wal_dir, telemetry=self.telemetry)
+                self.telemetry.opslog = self._opslog
+                pset = getattr(self.engine, "pset", None)
+                if pset is not None and hasattr(pset, "attach_opslog"):
+                    pset.attach_opslog(self._opslog)
             if cluster_hosts:
                 # write-path HA (RUNBOOK §2r): this worker is the lease
                 # holder; every WAL frame carries its fencing token, and
@@ -325,10 +339,18 @@ class SkylineWorker:
                         f"{held.holder!r} (epoch {held.epoch}); refusing to "
                         "start a second primary against the same WAL"
                     )
+                if self._opslog is not None:
+                    self._opslog.record(
+                        "lease_acquired",
+                        epoch=self._lease_keeper.epoch,
+                        fence=self._lease_plane.read_fence(),
+                        holder=self._lease_keeper.holder,
+                    )
                 self._wal = FencedWalWriter(
                     self._wal_dir,
                     self._lease_keeper.epoch,
                     plane=self._lease_plane,
+                    opslog=self._opslog,
                     **wal_kw,
                 )
                 status = getattr(self.telemetry, "cluster", None)
@@ -338,6 +360,26 @@ class SkylineWorker:
                     status.lease_cb = self._lease_plane.doc
             else:
                 self._wal = WalWriter(self._wal_dir, **wal_kw)
+            # WAL replication-plane families (RUNBOOK §2s): retained
+            # segments plus per-tailer ack age — a growing ack age is a
+            # stalled replica still pinning retention
+            def _wal_plane_series(wal=self._wal, wal_dir=self._wal_dir):
+                from skyline_tpu.resilience.wal import ack_ages_s
+
+                gauges: dict = {}
+                st = wal.stats()
+                gauges["wal_segments_retained"] = [
+                    ((), float(st.get("segments_retained", 0)))
+                ]
+                ages = ack_ages_s(wal_dir)
+                if ages:
+                    gauges["wal_tail_ack_age_s"] = [
+                        ((("tailer", t),), round(age, 3))
+                        for t, age in sorted(ages.items())
+                    ]
+                return {}, gauges
+
+            self.telemetry.replication.append(_wal_plane_series)
             # chip-local WAL segments for the sharded engine: per-chip
             # flush lineage + merge-time consistency barriers (policy
             # "merge", the default), or checkpoint-time barriers only
@@ -389,6 +431,10 @@ class SkylineWorker:
                 )
             from skyline_tpu.serve.replica import SkylineReplica
 
+            # in-process replicas share the worker's hub for the labeled
+            # replica families, the worker's ops journal, and see the
+            # primary head directly for replica_lag_versions
+            store = self._snap_store
             for i in range(int(replicas)):
                 self.replicas.append(
                     SkylineReplica(
@@ -396,6 +442,9 @@ class SkylineWorker:
                         port=0,
                         serve_config=serve_config,
                         replica_id=f"replica-{i}",
+                        telemetry=self.telemetry,
+                        opslog=self._opslog,
+                        primary_head_cb=lambda s=store: s.head_version,
                     )
                 )
         self.stats_server = None
@@ -445,6 +494,8 @@ class SkylineWorker:
                 }
             if self._chip_wal is not None:
                 res["chip_wal"] = self._chip_wal.stats()
+            if self._opslog is not None:
+                res["ops"] = self._opslog.stats()
             if self._recovered is not None:
                 res["recovered"] = self._recovered
             out["resilience"] = res
@@ -485,6 +536,9 @@ class SkylineWorker:
             except OSError:
                 pass
             self._chip_wal = None
+        if self._opslog is not None:
+            self._opslog.close()
+            self._opslog = None
 
     # -- crash recovery ----------------------------------------------------
 
@@ -758,6 +812,13 @@ class SkylineWorker:
         try:
             self._lease_keeper.maybe_renew()
         except LeaseLostError as e:
+            if self._opslog is not None:
+                self._opslog.record(
+                    "lease_renew_lost",
+                    epoch=self._lease_keeper.epoch,
+                    fence=self._lease_plane.read_fence(),
+                    error=str(e),
+                )
             self._demote(str(e))
 
     def _demote(self, reason: str) -> None:
@@ -767,6 +828,19 @@ class SkylineWorker:
         self._deposed = True
         self._stop_requested = True
         self.telemetry.inc("cluster.demotions")
+        if self._opslog is not None:
+            self._opslog.record(
+                "demoted",
+                epoch=(
+                    self._lease_keeper.epoch
+                    if self._lease_keeper is not None else None
+                ),
+                fence=(
+                    self._lease_plane.read_fence()
+                    if self._lease_plane is not None else None
+                ),
+                reason=reason,
+            )
         status = getattr(self.telemetry, "cluster", None)
         if status is not None:
             status.role = "deposed"

@@ -178,6 +178,7 @@ class ClusterPartitionSet:
         # elastic rebalance
         self._host_locks = [threading.Lock() for _ in range(hosts)]
         self._health = None
+        self._opslog = None
         self.migrations = 0
         self.last_migration: dict | None = None
         self.fenced_sources = 0
@@ -293,6 +294,12 @@ class ClusterPartitionSet:
         scorer reused with host indices): quarantine decisions drive
         ``maybe_failover``'s live migrations."""
         self._health = health
+
+    def attach_opslog(self, opslog) -> None:
+        """Attach the durable cross-process ops journal (RUNBOOK §2s):
+        host migrations and failovers become journal records beside the
+        flight-ring notes."""
+        self._opslog = opslog
 
     # -- ingest ----------------------------------------------------------------
 
@@ -673,6 +680,8 @@ class ClusterPartitionSet:
         self.last_migration = doc
         self._inc("cluster.migrations")
         self._fnote("cluster.migration", **doc)
+        if self._opslog is not None:
+            self._opslog.record("host_migrated", **doc)
         return doc
 
     def checkpoint_slice(self, hst: int, path: str) -> None:

@@ -231,6 +231,20 @@ class LeasePlane:
                 self._fence_sig = None  # force a re-read next check
             return max(cur, min_epoch)
 
+    def fence_doc(self) -> dict | None:
+        """The full fence file (min_epoch + the durable cut) for the ops
+        journal's ``fence_raised`` record; None when never fenced."""
+        try:
+            with open(self._path(FENCE_FILE), encoding="utf-8") as f:
+                doc = json.load(f)
+            return {
+                "min_epoch": int(doc.get("min_epoch", 0)),
+                "cut_seq": int(doc.get("cut_seq", 0)),
+                "cut_pos": int(doc.get("cut_pos", 0)),
+            }
+        except (OSError, ValueError, json.JSONDecodeError):
+            return None
+
     def doc(self) -> dict:
         rec = self.read_lease()
         return {
@@ -258,12 +272,23 @@ class FencedWalWriter(WalWriter):
         epoch: int,
         *,
         plane: LeasePlane | None = None,
+        opslog=None,
         **kw,
     ):
         self.plane = plane if plane is not None else LeasePlane(directory)
         self.epoch = int(epoch)
         self.fenced_writes = 0
+        self.opslog = opslog
         super().__init__(directory, **kw)
+
+    def _ops_rejected(self, fence: int, where: str) -> None:
+        # the zombie's own durable confession: a fenced append is exactly
+        # the split-brain evidence the ops timeline must carry
+        if self.opslog is not None:
+            self.opslog.record(
+                "zombie_append_rejected",
+                epoch=self.epoch, fence=fence, where=where,
+            )
 
     def _check_fence(self) -> None:
         fence = self.plane.read_fence()
@@ -271,6 +296,7 @@ class FencedWalWriter(WalWriter):
             self.fenced_writes += 1
             if self._telemetry is not None:
                 self._telemetry.inc("cluster.fenced_writes")
+            self._ops_rejected(fence, "pre_append")
             fault_point("wal.stale_fence")
             raise WalFencedError(
                 f"append rejected: writer epoch {self.epoch} is behind "
@@ -295,6 +321,7 @@ class FencedWalWriter(WalWriter):
             self.fenced_writes += 1
             if self._telemetry is not None:
                 self._telemetry.inc("cluster.fenced_writes")
+            self._ops_rejected(fence, "post_append")
             raise WalFencedError(
                 f"append raced a fence raise: writer epoch {self.epoch} is "
                 f"behind fence {fence}; readers will not fold frames past "
@@ -363,9 +390,15 @@ class LeaseKeeper:
         now = self.plane.clock() if now_ms is None else now_ms
         if now - self.record.renewed_ms < self.renew_ms:
             return False
+        t0 = time.perf_counter_ns()
         self.record = self.plane.renew(self.record)
         if self.telemetry is not None:
             self.telemetry.inc("cluster.lease_renewals")
+            # renew latency is the lease plane's fsync tax; a p99 drift
+            # here predicts spurious expiries before they happen
+            self.telemetry.histogram(
+                "cluster_lease_renew_ms", unit="ms"
+            ).observe((time.perf_counter_ns() - t0) / 1e6)
         return True
 
 
@@ -388,6 +421,7 @@ class ClusterSupervisor:
         lease_ttl_ms: float | None = None,
         telemetry=None,
         clock=None,
+        opslog=None,
     ):
         from skyline_tpu.analysis.registry import env_float
 
@@ -399,9 +433,14 @@ class ClusterSupervisor:
             else float(lease_ttl_ms)
         )
         self.telemetry = telemetry
+        self.opslog = opslog
         self.promotions = 0
         self.last_promotion: dict | None = None
         self._lock = threading.Lock()
+
+    def _ops(self, type_: str, **fields) -> None:
+        if self.opslog is not None:
+            self.opslog.record(type_, **fields)
 
     def _promoted(self):
         return next(
@@ -433,6 +472,11 @@ class ClusterSupervisor:
                         demote()
                     if self.telemetry is not None:
                         self.telemetry.inc("cluster.renewals_lost")
+                    self._ops(
+                        "lease_renew_lost",
+                        epoch=rec.epoch, holder=rec.holder,
+                        fence=self.plane.read_fence(),
+                    )
             # lease absent or expired: the write path is ownerless
             fault_point("cluster.lease_expire")
             t0 = time.perf_counter_ns()
@@ -446,9 +490,23 @@ class ClusterSupervisor:
             new_epoch = max(
                 (rec.epoch if rec is not None else 0), self.plane.read_fence()
             ) + 1
+            self._ops(
+                "lease_expired",
+                epoch=rec.epoch if rec is not None else None,
+                holder=rec.holder if rec is not None else None,
+            )
             # fence FIRST: from here the deposed epoch cannot append, so
             # nothing the old primary does can interleave with the drain
+            tf = time.perf_counter_ns()
             self.plane.raise_fence(new_epoch)
+            fence_ms = (time.perf_counter_ns() - tf) / 1e6
+            cut = self.plane.fence_doc() or {}
+            self._ops(
+                "fence_raised",
+                epoch=new_epoch, fence=new_epoch,
+                cut_seq=cut.get("cut_seq"), cut_pos=cut.get("cut_pos"),
+                wall_ms=round(fence_ms, 3),
+            )
             lease = self.plane.acquire(
                 best.replica_id, self.lease_ttl_ms, epoch=new_epoch
             )
@@ -467,9 +525,20 @@ class ClusterSupervisor:
             self.last_promotion = doc
             if self.telemetry is not None:
                 self.telemetry.inc("cluster.promotions")
+                # real histograms, not one-shot bench numbers: /slo's
+                # promote_p99 row and the sentinel read these
                 self.telemetry.histogram(
                     "cluster_time_to_promote_ms", unit="ms"
                 ).observe(wall_ms)
+                self.telemetry.histogram(
+                    "cluster_fence_raise_ms", unit="ms"
+                ).observe(fence_ms)
+            self._ops(
+                "promoted",
+                epoch=lease.epoch, holder=best.replica_id,
+                deposed=doc["deposed"], head_version=doc["head_version"],
+                wall_ms=doc["time_to_promote_ms"],
+            )
             return doc
 
     def doc(self) -> dict:

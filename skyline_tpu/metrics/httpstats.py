@@ -37,6 +37,14 @@ Endpoints:
   GET /cluster  cluster block (RUNBOOK §2r): lease/role state, fenced
                 writes, promotions, per-host ingest/merge/prune stats
                 (non-cluster workers report {"enabled": false})
+  GET /ops      durable cross-process ops journal (RUNBOOK §2s): every
+                control-plane transition, merged across writers
+                (?since_seq=N per-writer floor, ?limit=N newest records;
+                workers without a journal report {"enabled": false})
+  GET /cluster/overview  fleet-wide aggregation (RUNBOOK §2s): every
+                member's role/epoch/fence/head + replication lag + the
+                epoch-agreement (split-brain) findings; members come from
+                an attached ClusterView or $SKYLINE_CLUSTERVIEW_MEMBERS
   GET /healthz  {"ok": true} once serving — readiness probe for supervisors
 """
 
@@ -228,6 +236,14 @@ class StatsServer:
                         handler._reply(200, outer._cluster_doc())
                     except Exception as e:
                         handler._reply(500, {"error": str(e)})
+                elif path == "/ops":
+                    code, doc = outer._ops_doc(qs)
+                    handler._reply(code, doc)
+                elif path == "/cluster/overview":
+                    try:
+                        handler._reply(200, outer._overview_doc())
+                    except Exception as e:
+                        handler._reply(500, {"error": str(e)})
                 elif path in ("/", "/ui"):
                     handler._reply_raw(
                         200, _DASHBOARD.encode(), "text/html; charset=utf-8"
@@ -326,6 +342,35 @@ class StatsServer:
         if status is None:
             return {"ok": True, "enabled": False}
         return status.doc()
+
+    def _ops_doc(self, qs: str) -> tuple[int, dict]:
+        """The /ops journal tail (RUNBOOK §2s): the merged cross-process
+        timeline from the hub's attached OpsLog. Probe-friendly —
+        ``enabled`` is false when this process opened no journal."""
+        from skyline_tpu.telemetry.opslog import ops_doc
+
+        params = {k: v[-1] for k, v in parse_qs(qs).items()}
+        try:
+            since = (
+                int(params["since_seq"]) if "since_seq" in params else None
+            )
+            limit = int(params["limit"]) if "limit" in params else None
+        except ValueError:
+            return 400, {"error": "since_seq/limit must be integers"}
+        ops = (
+            getattr(self.telemetry, "opslog", None)
+            if self.telemetry is not None
+            else None
+        )
+        if ops is None:
+            return 200, {"ok": True, "enabled": False}
+        return 200, ops_doc(ops.wal_dir, since_seq=since, limit=limit)
+
+    def _overview_doc(self) -> dict:
+        """The /cluster/overview fleet aggregation (RUNBOOK §2s)."""
+        from skyline_tpu.telemetry.clusterview import overview_doc
+
+        return overview_doc(self.telemetry)
 
     def _render_metrics(self) -> tuple[bytes, str]:
         """Prometheus text: the stats dict flattened to gauges, plus the

@@ -1,0 +1,10 @@
+"""CLI alias: ``python -m skyline_tpu.opslog`` pretty-prints/diffs the
+cluster ops journal (the implementation lives in
+``skyline_tpu.telemetry.opslog``; this module exists so the CLI sits
+beside ``python -m skyline_tpu.explain`` and ``python -m
+skyline_tpu.audit`` in the operator's muscle memory — RUNBOOK §2s)."""
+
+from skyline_tpu.telemetry.opslog import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

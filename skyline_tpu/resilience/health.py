@@ -189,6 +189,12 @@ class ChipHealth:
             if fl is not None:
                 fl.note("health.quarantine", chip=chip, reason=reason,
                         score=round(r.score, 3))
+            ops = getattr(tel, "opslog", None)
+            if ops is not None:
+                ops.record(
+                    "chip_quarantined", chip=chip, reason=reason,
+                    score=round(r.score, 3),
+                )
 
     def quarantine(self, chip: int, reason: str) -> None:
         """Operator/test hook: quarantine unconditionally."""
@@ -214,6 +220,12 @@ class ChipHealth:
                     fl = getattr(tel, "flight", None)
                     if fl is not None:
                         fl.note("health.heal", chip=chip)
+                    # a heal out of quarantine is the failover plane
+                    # returning the slot to service — the ops journal's
+                    # "chip_failover" completion marker
+                    ops = getattr(tel, "opslog", None)
+                    if ops is not None:
+                        ops.record("chip_failover", chip=chip)
 
     # -- reads ------------------------------------------------------------
 

@@ -167,6 +167,22 @@ def tail_retention_floor(directory: str, ttl_s: float | None = None) -> int | No
     return floor
 
 
+def ack_ages_s(directory: str) -> dict[str, float]:
+    """``{tailer_id: seconds since its retention ack was refreshed}`` —
+    the replication plane's liveness gauge (RUNBOOK §2s): a growing ack
+    age is a stalled or dead tailer still pinning segment retention."""
+    out: dict[str, float] = {}
+    now = time.time()
+    for path in _ack_files(directory):
+        name = os.path.basename(path)
+        tailer = name[len("tail-"):-len(".ack")] or name
+        try:
+            out[tailer] = max(0.0, now - os.path.getmtime(path))
+        except OSError:
+            continue  # withdrawn mid-scan
+    return out
+
+
 class _FenceView:
     """Read-side view of ``fence.json``: ``(min_epoch, cut_seq, cut_pos)``
     or ``None`` when the directory is unfenced (non-cluster mode — the

@@ -76,6 +76,8 @@ class SkylineReplica:
         max_restarts: int | None = None,
         backoff_base_s: float | None = None,
         start: bool = True,
+        opslog=None,
+        primary_head_cb=None,
     ):
         from skyline_tpu.analysis.registry import env_float
         from skyline_tpu.serve import (
@@ -130,6 +132,16 @@ class SkylineReplica:
         self.rebootstraps = 0
         self.last_error: str | None = None
         self.supervisor = None
+        # ops plane (RUNBOOK §2s): the shared cross-process journal (None
+        # outside a cluster) and the primary-head callback that turns
+        # "my head" into "versions behind the primary" for
+        # skyline_replica_lag_versions{replica=...}
+        self.opslog = opslog
+        self.primary_head_cb = primary_head_cb
+        self.last_lag_ms: float | None = None
+        repl = getattr(self.telemetry, "replication", None)
+        if repl is not None:
+            repl.append(self)
         if start:
             self.start()
 
@@ -156,6 +168,12 @@ class SkylineReplica:
         records = self._tailer.poll()
         self._fold(records)
         self.bootstraps += 1
+        if self.opslog is not None:
+            self.opslog.record(
+                "replica_bootstrap",
+                replica=self.replica_id,
+                head_version=self.store.head_version,
+            )
 
     def _newest_barrier_seq(self) -> int | None:
         from skyline_tpu.resilience.wal import (
@@ -280,8 +298,10 @@ class SkylineReplica:
             )
         self.records_applied += 1
         if rec.get("ts") is not None:
+            lag_ms = max(0.0, time.time() * 1000.0 - float(rec["ts"]))
+            self.last_lag_ms = lag_ms
             self.telemetry.histogram("replica_tail_lag_ms", unit="ms").observe(
-                max(0.0, time.time() * 1000.0 - float(rec["ts"]))
+                lag_ms
             )
 
     def apply_available(self) -> int:
@@ -304,6 +324,11 @@ class SkylineReplica:
         self.last_error = f"{type(err).__name__}: {err}"
         self.rebootstraps += 1
         self.telemetry.inc("replica.rebootstraps")
+        if self.opslog is not None:
+            self.opslog.record(
+                "replica_rebootstrap",
+                replica=self.replica_id, error=self.last_error,
+            )
         print(
             f"replica {self.replica_id}: {self.last_error}; re-bootstrapping",
             file=sys.stderr,
@@ -406,9 +431,14 @@ class SkylineReplica:
         """Rejoin as a follower after deposition — the honest path once
         this node's writer starts raising ``WalFencedError``. Restarts
         the supervised tail loop."""
+        was_epoch = self.promoted_epoch
         self.role = "replica"
         self.promoted_epoch = None
         self.server.role = "replica"
+        if self.opslog is not None:
+            self.opslog.record(
+                "demoted", replica=self.replica_id, epoch=was_epoch
+            )
         if self._thread is None:
             self._stop = threading.Event()
             self.start()
@@ -422,6 +452,53 @@ class SkylineReplica:
                 return True
             time.sleep(0.005)
         return False
+
+    def labeled_series(self):
+        """Per-replica Prometheus families (RUNBOOK §2s) — the tailer's
+        in-memory stats made scrapable: ``skyline_replica_lag_ms{replica=}``,
+        ``skyline_replica_lag_versions{replica=}`` (when a primary-head
+        callback is wired), and the ``stale_frames_skipped`` /
+        ``partial_retries`` / rebootstrap counts that were previously
+        visible only in the stats dict."""
+        labels = (("replica", str(self.replica_id)),)
+        counters: dict = {}
+        gauges: dict = {}
+
+        def _c(name, value):
+            counters.setdefault(name, []).append((labels, float(value)))
+
+        def _g(name, value):
+            gauges.setdefault(name, []).append((labels, float(value)))
+
+        _c("replica_records_applied", self.records_applied)
+        _c("replica_bootstraps", self.bootstraps)
+        _c("replica_rebootstraps", self.rebootstraps)
+        _g("replica_head_version", self.store.head_version)
+        if self.last_lag_ms is not None:
+            _g("replica_lag_ms", self.last_lag_ms)
+        if self.primary_head_cb is not None:
+            try:
+                primary_head = int(self.primary_head_cb())
+            except Exception:
+                primary_head = None
+            if primary_head is not None:
+                _g(
+                    "replica_lag_versions",
+                    max(0, primary_head - self.store.head_version),
+                )
+        tailer = self._tailer
+        if tailer is not None:
+            try:
+                ts = tailer.stats()
+            except Exception:
+                ts = {}
+            _c("replica_stale_frames_skipped", ts.get("stale_frames_skipped", 0))
+            _c("replica_partial_retries", ts.get("partial_retries", 0))
+            _c("replica_frames_read", ts.get("frames_read", 0))
+            _c("replica_segments_finished", ts.get("segments_finished", 0))
+            _g("replica_tailer_segment_seq", ts.get("segment_seq", 0))
+            _g("replica_tailer_position", ts.get("position", 0))
+        return counters, gauges
 
     def stats(self) -> dict:
         out = {
@@ -450,6 +527,9 @@ class SkylineReplica:
             self._thread.join(timeout=10.0)
         if self._tailer is not None:
             self._tailer.close()
+        repl = getattr(self.telemetry, "replication", None)
+        if repl is not None and self in repl:
+            repl.remove(self)
         self.server.close()
 
 
@@ -472,14 +552,22 @@ def run_replica(
     withdrawing its retention ack — and the server) and exit 0."""
     import signal
 
+    from skyline_tpu.telemetry.opslog import OpsLog, opslog_enabled
+
     stop = threading.Event()
+    ops = None
+    if opslog_enabled():
+        ops = OpsLog(wal_dir)
     replica = SkylineReplica(
         wal_dir,
         port=port,
         host=host,
         serve_config=serve_config,
         replica_id=replica_id,
+        opslog=ops,
     )
+    # this process's journal behind the replica surface's GET /ops
+    replica.telemetry.opslog = ops
     if install_signal_handlers:
 
         def _drain(signum, frame):
@@ -497,4 +585,6 @@ def run_replica(
             stop.wait(0.2)
     finally:
         replica.close()
+        if ops is not None:
+            ops.close()
     return 0

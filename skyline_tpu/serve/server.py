@@ -48,6 +48,13 @@ operator observability; this one serves the skyline itself. Endpoints:
   GET  /cluster   cluster block (RUNBOOK §2r): lease/role state, fenced
                   writes, promotions, per-host ingest/merge/prune stats
                   (non-cluster workers report {"enabled": false}).
+  GET  /ops       durable cross-process ops journal (RUNBOOK §2s): the
+                  merged control-plane timeline (``?since_seq=N``
+                  per-writer floor, ``?limit=N`` newest records; workers
+                  without a journal report {"enabled": false}).
+  GET  /cluster/overview  fleet-wide aggregation (RUNBOOK §2s): member
+                  roles/epochs/fences/heads, replication lag, and the
+                  epoch-agreement (split-brain) findings.
 
 Requests never touch the engine: reads come off the ``SnapshotStore``;
 forced queries cross to the worker thread through ``QueryBridge`` (the
@@ -534,6 +541,10 @@ class SkylineServer:
             await self._health(writer)
         elif path == "/cluster" and method == "GET":
             await self._cluster(writer)
+        elif path == "/ops" and method == "GET":
+            await self._ops(writer, params)
+        elif path == "/cluster/overview" and method == "GET":
+            await self._overview(writer)
         else:
             await self._reply(writer, 404, {"error": "not found"})
 
@@ -836,6 +847,39 @@ class SkylineServer:
             await self._reply(writer, 200, status.doc())
         except Exception as e:  # observability must not 500 the plane down
             await self._reply(writer, 500, {"error": str(e)})
+
+    async def _ops(self, writer, params):
+        """The /ops journal tail (RUNBOOK §2s): the merged cross-process
+        control-plane timeline. Probe-friendly — {"enabled": false} when
+        this process opened no journal."""
+        from skyline_tpu.telemetry.opslog import ops_doc
+
+        try:
+            since = _int_param(params, "since_seq")
+            limit = _int_param(params, "limit")
+        except ValueError as e:
+            await self._reply(writer, 400, {"error": str(e)})
+            return
+        ops = getattr(self.telemetry, "opslog", None)
+        if ops is None:
+            await self._reply(writer, 200, {"ok": True, "enabled": False})
+            return
+        await self._reply(
+            writer, 200, ops_doc(ops.wal_dir, since_seq=since, limit=limit)
+        )
+
+    async def _overview(self, writer):
+        """The /cluster/overview fleet aggregation (RUNBOOK §2s):
+        per-member role/epoch/fence/head, replication lag, and the
+        epoch-agreement (split-brain) findings. The scrape is blocking
+        network I/O, so it runs in an executor — a member whose view
+        lists its own URL must not stall the loop that would answer
+        that self-scrape."""
+        from skyline_tpu.telemetry.clusterview import overview_doc
+
+        loop = asyncio.get_running_loop()
+        doc = await loop.run_in_executor(None, overview_doc, self.telemetry)
+        await self._reply(writer, 200, doc)
 
     async def _deltas(self, writer, params, tenant=None):
         ok, retry = self.admission.admit_read(tenant=tenant)

@@ -733,6 +733,17 @@ class SkylineEngine:
             self.telemetry.inc("queries.answered")
             if degraded is not None:
                 self.telemetry.inc("degraded_answers")
+                # degraded publishes are control-plane transitions: the
+                # fleet's honest-availability story must survive the
+                # process, so they join the durable ops journal
+                ops = getattr(self.telemetry, "opslog", None)
+                if ops is not None:
+                    ops.record(
+                        "degraded_publish",
+                        trace_id=q.trace_id,
+                        excluded_chips=degraded["excluded_chips"],
+                        completeness_bound=degraded["completeness_bound"],
+                    )
             self.telemetry.histogram("query_latency_ms").observe(latency_ms)
             if q.span_t0_ns:
                 self.telemetry.spans.record(

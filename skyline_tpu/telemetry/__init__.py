@@ -40,6 +40,16 @@ from skyline_tpu.telemetry.spans import SpanRecorder, mint_trace_id
 from skyline_tpu.telemetry.workload import WorkloadCharacterizer
 
 
+def _extend_labeled(dst: dict | None, src: dict) -> dict:
+    """Merge labeled-series maps by EXTENDING each family's series list —
+    two replicas both exporting ``replica_lag_ms`` must coexist in one
+    family, which the plain dict union cannot express."""
+    out = {k: list(v) for k, v in (dst or {}).items()}
+    for family, series in src.items():
+        out.setdefault(family, []).extend(series)
+    return out
+
+
 class Telemetry:
     """One shared hub: counters + named histograms + the span ring.
 
@@ -83,6 +93,18 @@ class Telemetry:
         # (None outside a cluster); serves GET /cluster on both HTTP
         # surfaces and the skyline_host_*{host=...} metric families
         self.cluster = None
+        # ops plane (RUNBOOK §2s): the durable cross-process control-plane
+        # journal (``telemetry.opslog.OpsLog``) attached by whichever
+        # process opened one beside the WAL; serves GET /ops on both HTTP
+        # surfaces. ``replication`` is a LIST of labeled-series providers
+        # (each a callable or object with ``labeled_series() ->
+        # (counters, gauges)``) — replicas and the WAL plane register here
+        # so skyline_replica_*{replica=...} / wal families reach /metrics.
+        # ``clusterview`` is an optional ``telemetry.clusterview.
+        # ClusterView`` behind GET /cluster/overview.
+        self.opslog = None
+        self.replication: list = []
+        self.clusterview = None
 
     def inc(self, name: str, n: int = 1) -> None:
         """Bump a named monotonic counter (shorthand for
@@ -162,6 +184,22 @@ class Telemetry:
                 labeled_counters = {**(labeled_counters or {}), **host_counters}
             if host_gauges:
                 labeled_gauges = {**(labeled_gauges or {}), **host_gauges}
+        # replication providers (RUNBOOK §2s): several replicas can share
+        # one hub, each contributing series to the SAME family
+        # (skyline_replica_lag_ms{replica=...}), so the merge must EXTEND
+        # family lists rather than replace them like the dict unions above
+        for provider in list(self.replication):
+            try:
+                fn = getattr(provider, "labeled_series", provider)
+                repl_counters, repl_gauges = fn()
+            except Exception:
+                continue  # a dying replica must not break /metrics
+            if repl_counters:
+                labeled_counters = _extend_labeled(
+                    labeled_counters, repl_counters
+                )
+            if repl_gauges:
+                labeled_gauges = _extend_labeled(labeled_gauges, repl_gauges)
         if extra_labeled_counters:
             # per-tenant admission series from the serve plane ride along
             # the fleet's per-chip families

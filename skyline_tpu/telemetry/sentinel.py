@@ -82,6 +82,12 @@ DEFAULT_RULES = (
     {"label": "cluster.time_to_promote_ms",
      "path": ["cluster", "time_to_promote_ms"], "higher_is_better": False,
      "threshold": 2.0},
+    # ops plane (ISSUE 17): a replication-lag blowup means a failover
+    # would inherit that much staleness; quantile from the replica leg's
+    # real tail-lag histogram, same CPU-noise threshold discipline
+    {"label": "cluster.replication_lag_p99_ms",
+     "path": ["cluster", "replication_lag_p99_ms"], "higher_is_better": False,
+     "threshold": 2.0},
 )
 
 

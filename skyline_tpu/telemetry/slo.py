@@ -1,6 +1,6 @@
 """Declarative SLOs with multi-window burn-rate evaluation (``GET /slo``).
 
-Seven objectives, each a row in a declarative table (targets are knobs,
+Nine objectives, each a row in a declarative table (targets are knobs,
 see RUNBOOK §2j):
 
 - ``read_p99``       — 99% of /skyline reads complete under
@@ -24,6 +24,13 @@ see RUNBOOK §2j):
                        per-tenant buckets (RUNBOOK §2q); ``evaluate()``
                        also carries a cumulative per-tenant breakdown so
                        the burning tenant is identifiable.
+- ``replication_lag_p99`` — 99% of replica WAL-fold applications land
+                       under ``SKYLINE_SLO_REPLICATION_LAG_P99_MS`` of
+                       the frame's publish time (RUNBOOK §2s) — the
+                       staleness a failover would inherit.
+- ``promote_p99``    — 99% of supervisor promotions (fence raise →
+                       replica serving) complete under
+                       ``SKYLINE_SLO_PROMOTE_P99_MS`` (RUNBOOK §2s).
 
 Evaluation is the standard SRE multi-window scheme: each ``evaluate()``
 samples the cumulative counters, appends them to a bounded ring, and diffs
@@ -96,6 +103,13 @@ class SloEngine:
             "tenant_shed_fraction": (
                 "fraction", env_float("SKYLINE_SLO_TENANT_SHED", 0.05),
             ),
+            "replication_lag_p99": (
+                "quantile",
+                env_float("SKYLINE_SLO_REPLICATION_LAG_P99_MS", 2000.0),
+            ),
+            "promote_p99": (
+                "quantile", env_float("SKYLINE_SLO_PROMOTE_P99_MS", 1000.0),
+            ),
         }
         self._admission = None  # serve-plane counters (reads_served/shed)
         self._lock = threading.Lock()
@@ -141,6 +155,18 @@ class SloEngine:
                 t_total += int(row["admitted"]) + int(row["shed"])
                 t_shed += int(row["shed"])
         out["tenant_shed_fraction"] = (t_total, t_shed)
+        # cluster ops plane (RUNBOOK §2s): replica apply lag and
+        # supervisor promotion wall — both real histograms, fed by
+        # serve/replica.py and cluster/lease.py respectively; get-or-create
+        # means zero-count rows outside a cluster (burn 0, no breach)
+        lag_hist = tel.histogram("replica_tail_lag_ms")
+        out["replication_lag_p99"] = _hist_over(
+            lag_hist, self.table["replication_lag_p99"][1]
+        )
+        promote_hist = tel.histogram("cluster_time_to_promote_ms")
+        out["promote_p99"] = _hist_over(
+            promote_hist, self.table["promote_p99"][1]
+        )
         return out
 
     def _window(self, samples, now_s: float, window_s: float, name: str):

@@ -236,6 +236,7 @@ def test_slo_engine_healthy_and_breach():
     assert set(doc["slos"]) == {
         "read_p99", "freshness_p99", "shed_fraction", "restart_rate",
         "audit_divergence", "degraded_answers", "tenant_shed_fraction",
+        "replication_lag_p99", "promote_p99",
     }
     # now every read blows the target: burn must exceed 1 on BOTH windows
     t["now"] = 30.0

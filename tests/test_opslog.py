@@ -1,0 +1,477 @@
+"""Ops plane (ISSUE 17): durable cross-process ops journal, fleet-wide
+clusterview aggregation, and replication/fencing telemetry.
+
+Acceptance bars:
+
+- the journal survives torn tails and CRC-corrupt frames exactly like
+  the WAL: every record before the first bad frame is returned, the
+  tear is counted, nothing raises;
+- two writers appending into the same journal directory keep their seqs
+  monotone per writer and the reader merges the timeline by wall time;
+- the clusterview flags an injected split-brain (two live primaries; a
+  writer below the fleet's max fence) as named findings and stays quiet
+  on a healthy grid;
+- replica lag renders as LABELED Prometheus families
+  (``skyline_replica_lag_versions{replica=...}``) and the unlabeled
+  exposition stays byte-identical when no labeled provider registers;
+- ``GET /ops`` and ``GET /cluster/overview`` answer on the stats
+  surface, probe-friendly when the plane is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry import opslog as opsmod
+from skyline_tpu.telemetry.clusterview import (
+    hist_quantile,
+    overview_from_members,
+    parse_prometheus,
+)
+from skyline_tpu.telemetry.opslog import (
+    OpsLog,
+    list_journals,
+    ops_doc,
+    read_ops,
+)
+
+from conftest import parse_prometheus_text
+
+
+# ---------------------------------------------------------------------------
+# journal durability
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_fields_and_since_seq(tmp_path):
+    d = str(tmp_path)
+    ops = OpsLog(d, process_id="worker-a-1", fsync="off")
+    try:
+        rec = ops.record(
+            "fence_raised", epoch=3, fence=3, trace_id="t-1", cut_seq=7
+        )
+        assert rec is not None and rec["seq"] == 1
+        ops.record("promoted", epoch=3, holder="r0")
+        ops.record("demoted", epoch=2)
+    finally:
+        ops.close()
+    doc = read_ops(d)
+    assert doc["enabled"] and doc["writers"] == 1 and doc["torn"] == 0
+    assert doc["total"] == 3
+    first = doc["records"][0]
+    assert first["type"] == "fence_raised"
+    assert first["proc"] == "worker-a-1"
+    assert first["epoch"] == first["fence"] == 3
+    assert first["trace_id"] == "t-1" and first["cut_seq"] == 7
+    assert first["t_ms"] > 0
+    # since_seq is a per-writer high-water mark: only the unseen suffix
+    tail = read_ops(d, since_seq=1)
+    assert [r["seq"] for r in tail["records"]] == [2, 3]
+    assert read_ops(d, since_seq=3)["total"] == 0
+    # limit keeps the newest N after filtering
+    assert [r["seq"] for r in read_ops(d, limit=1)["records"]] == [3]
+
+
+def test_torn_tail_returns_prefix(tmp_path):
+    d = str(tmp_path)
+    ops = OpsLog(d, fsync="off")
+    for i in range(5):
+        ops.record("lease_acquired", epoch=i)
+    ops.close()
+    (path,) = list_journals(d)
+    # an os.write cut mid-frame leaves a frame prefix: simulate the crash
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\x99")  # header + truncated payload
+    doc = read_ops(d)
+    assert doc["torn"] == 1
+    assert [r["seq"] for r in doc["records"]] == [1, 2, 3, 4, 5]
+
+
+def test_crc_corruption_keeps_trustworthy_prefix(tmp_path):
+    d = str(tmp_path)
+    ops = OpsLog(d, fsync="off")
+    for i in range(6):
+        ops.record("lease_acquired", epoch=i)
+    ops.close()
+    (path,) = list_journals(d)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # flip one payload byte two-thirds in: full-length garbage, CRC must
+    # catch it and the reader must stop there without raising
+    data[len(data) * 2 // 3] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(data)
+    doc = read_ops(d)
+    assert doc["torn"] == 1
+    seqs = [r["seq"] for r in doc["records"]]
+    assert 0 < len(seqs) < 6
+    assert seqs == sorted(seqs)
+
+
+def test_bad_magic_is_torn_not_fatal(tmp_path):
+    d = str(tmp_path)
+    ops = OpsLog(d, fsync="off")
+    ops.record("promoted", epoch=1)
+    ops.close()
+    (path,) = list_journals(d)
+    with open(path, "r+b") as f:
+        f.write(b"NOPE")
+    doc = read_ops(d)
+    assert doc["torn"] == 1 and doc["total"] == 0
+
+
+def test_size_cap_drops_and_counts_never_raises(tmp_path):
+    d = str(tmp_path)
+    ops = OpsLog(d, fsync="off", max_bytes=256)
+    wrote = dropped = 0
+    for i in range(50):
+        if ops.record("lease_acquired", epoch=i) is None:
+            dropped += 1
+        else:
+            wrote += 1
+    assert dropped > 0 and wrote > 0
+    st = ops.stats()
+    assert st["dropped"] == dropped and st["appends"] == wrote
+    ops.close()
+    assert ops.record("promoted") is None  # closed: counted, not raised
+    assert read_ops(d)["total"] == wrote
+
+
+# ---------------------------------------------------------------------------
+# cross-process interleaving
+# ---------------------------------------------------------------------------
+
+
+def test_two_writers_merge_by_wall_time(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    tick = {"now": 1000.0}
+
+    def clock():
+        tick["now"] += 1.0
+        return tick["now"] / 1000.0  # time.time() is in seconds
+
+    monkeypatch.setattr(opsmod.time, "time", clock)
+    a = OpsLog(d, process_id="worker-a-1", fsync="off")
+    b = OpsLog(d, process_id="worker-b-2", fsync="off")
+    try:
+        # strict interleave in wall time: a, b, a, b, a, b
+        for i in range(3):
+            a.record("lease_acquired", epoch=i)
+            b.record("replica_bootstrap", replica=f"r{i}")
+    finally:
+        a.close()
+        b.close()
+    doc = read_ops(d)
+    assert doc["writers"] == 2 and doc["torn"] == 0 and doc["total"] == 6
+    recs = doc["records"]
+    # merged timeline reads in wall-time order across processes
+    assert [r["t_ms"] for r in recs] == sorted(r["t_ms"] for r in recs)
+    assert [r["proc"][7] for r in recs] == list("ababab")
+    # per-writer seq stays monotone through the merge
+    for proc in ("worker-a-1", "worker-b-2"):
+        seqs = [r["seq"] for r in recs if r["proc"] == proc]
+        assert seqs == sorted(seqs) == [1, 2, 3]
+    # since_seq filters per writer, not globally
+    tail = read_ops(d, since_seq=2)
+    assert sorted((r["proc"], r["seq"]) for r in tail["records"]) == [
+        ("worker-a-1", 3),
+        ("worker-b-2", 3),
+    ]
+
+
+def test_fresh_file_per_incarnation(tmp_path):
+    d = str(tmp_path)
+    first = OpsLog(d, fsync="off")
+    first.record("lease_acquired", epoch=1)
+    first.close()
+    second = OpsLog(d, fsync="off")
+    second.record("lease_acquired", epoch=2)
+    second.close()
+    # a restart never appends into a file a crashed incarnation may have
+    # left torn — one journal file per incarnation
+    assert len(list_journals(d)) == 2
+    assert read_ops(d)["total"] == 2
+
+
+def test_ops_doc_probe_friendly():
+    assert ops_doc(None) == {"ok": True, "enabled": False}
+    assert ops_doc("/nonexistent-skyline-opslog-dir")["enabled"] is False
+
+
+def test_cli_print_and_diff(tmp_path, capsys):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for d, epochs in ((d1, (1, 2)), (d2, (1,))):
+        ops = OpsLog(d, process_id="worker-cli-9", fsync="off")
+        for e in epochs:
+            ops.record("fence_raised", epoch=e, fence=e)
+        ops.close()
+    assert opsmod.main([d1, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["total"] == 2
+    assert opsmod.main([d1, d2]) == 0
+    out = capsys.readouterr().out
+    assert "fence_raised" in out
+    assert opsmod.main(["/nonexistent-skyline-opslog-dir", "--json"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# clusterview: healthy grid quiet, injected split-brain flagged
+# ---------------------------------------------------------------------------
+
+
+def _member(url, role, epoch, fence, head, ok=True):
+    return {
+        "url": url,
+        "ok": ok,
+        "healthz": {"ok": ok, "role": role},
+        "cluster": {
+            "enabled": True,
+            "role": role,
+            "lease": {"epoch": epoch},
+            "fence": fence,
+        },
+        "metrics": {"skyline_snapshot_store_head_version": float(head)},
+        "ops": {"enabled": True, "records": [], "writers": 1},
+    }
+
+
+def test_clusterview_quiet_on_healthy_grid():
+    doc = overview_from_members(
+        [
+            _member("http://a", "primary", 4, 4, 30),
+            _member("http://b", "replica", 4, 4, 28),
+            _member("http://c", "replica", 4, 4, 30),
+        ],
+        now_ms=1.0,
+    )
+    assert doc["ok"] is True and doc["findings"] == []
+    assert doc["fleet"]["live"] == 3 and doc["fleet"]["primaries"] == 1
+    assert doc["fleet"]["primary_head_version"] == 30
+    lags = {
+        m["url"]: m["replication_lag_versions"]
+        for m in doc["members"]
+        if m["role"] != "primary"
+    }
+    assert lags["http://b"] == 2 and lags["http://c"] == 0
+
+
+def test_clusterview_flags_injected_split_brain():
+    doc = overview_from_members(
+        [
+            _member("http://a", "primary", 3, 5, 30),  # below fleet fence
+            _member("http://b", "primary", 5, 5, 30),
+        ],
+        now_ms=1.0,
+    )
+    assert doc["ok"] is False
+    names = sorted(f["name"] for f in doc["findings"])
+    assert names == ["multiple_primaries", "primary_below_fence"]
+    assert all(f["severity"] == "critical" for f in doc["findings"])
+    # a DEAD duplicate primary is not a split-brain: liveness gates it
+    quiet = overview_from_members(
+        [
+            _member("http://a", "primary", 5, 5, 30),
+            _member("http://b", "primary", 4, 5, 30, ok=False),
+        ],
+        now_ms=1.0,
+    )
+    assert [f["name"] for f in quiet["findings"]] == []
+
+
+def test_split_brain_evidence_from_real_fence(tmp_path):
+    """The stale-fence story end to end on real components: a fenced
+    writer's zombie append is rejected AND journaled, and the clusterview
+    built from the real lease-plane state names the finding."""
+    from skyline_tpu.cluster import (
+        FencedWalWriter,
+        LeasePlane,
+        WalFencedError,
+    )
+
+    d = str(tmp_path)
+    ops = OpsLog(d, process_id="worker-zombie-1", fsync="off")
+    plane = LeasePlane(d)
+    lease = plane.acquire("primary-0", ttl_ms=60_000.0)
+    writer = FencedWalWriter(
+        d, lease.epoch, plane=plane, fsync="off", opslog=ops
+    )
+    try:
+        new_epoch = plane.raise_fence(lease.epoch + 1)  # fence the zombie
+        with pytest.raises(WalFencedError):
+            writer.append({"type": "delta", "probe": True})
+    finally:
+        writer.close()
+        ops.close()
+    recs = read_ops(d)["records"]
+    zombies = [r for r in recs if r["type"] == "zombie_append_rejected"]
+    assert zombies and zombies[0]["fence"] == new_epoch
+    assert zombies[0]["epoch"] == lease.epoch
+    # the view over that real state: old-epoch writer still claiming
+    # primary under the raised fence is a named critical finding
+    doc = overview_from_members(
+        [_member("http://a", "primary", lease.epoch, new_epoch, 1)],
+        now_ms=1.0,
+    )
+    assert [f["name"] for f in doc["findings"]] == ["primary_below_fence"]
+
+
+def test_parse_prometheus_and_hist_quantile():
+    text = (
+        "# TYPE skyline_x_total counter\n"
+        "skyline_x_total 3\n"
+        'skyline_replica_lag_ms{replica="r0"} 12.5\n'
+        'skyline_tail_ms_bucket{le="1"} 0\n'
+        'skyline_tail_ms_bucket{le="10"} 8\n'
+        'skyline_tail_ms_bucket{le="+Inf"} 10\n'
+    )
+    samples = parse_prometheus(text)
+    assert samples["skyline_x_total"] == 3.0
+    assert samples['skyline_replica_lag_ms{replica="r0"}'] == 12.5
+    q = hist_quantile(samples, "skyline_tail_ms", 0.5)
+    assert q is not None and 1.0 <= q <= 10.0
+
+
+# ---------------------------------------------------------------------------
+# replication telemetry: labeled families, unlabeled byte-identity
+# ---------------------------------------------------------------------------
+
+
+def test_unlabeled_exposition_byte_identical_without_providers():
+    def build():
+        tel = Telemetry()
+        tel.inc("queries")
+        tel.histogram("merge_ms", unit="ms").observe(3.0)
+        return tel
+
+    base = build().render_prometheus()
+    quiet = build()
+    quiet.replication.append(lambda: ({}, {}))  # plane on, nothing to say
+    assert quiet.render_prometheus() == base
+
+
+def test_labeled_replica_families_render_and_survive_bad_provider():
+    tel = Telemetry()
+    tel.inc("queries")
+
+    def provider():
+        return (
+            {"replica_rebootstraps": [((("replica", "r0"),), 2.0)]},
+            {
+                "replica_lag_ms": [
+                    ((("replica", "r0"),), 12.5),
+                    ((("replica", "r1"),), 3.0),
+                ],
+                "replica_lag_versions": [((("replica", "r0"),), 4.0)],
+            },
+        )
+
+    def dying():
+        raise RuntimeError("replica died mid-scrape")
+
+    tel.replication.extend([provider, dying])
+    text = tel.render_prometheus()
+    assert 'skyline_replica_lag_ms{replica="r0"} 12.5' in text
+    assert 'skyline_replica_lag_ms{replica="r1"} 3' in text
+    assert 'skyline_replica_lag_versions{replica="r0"} 4' in text
+    assert 'skyline_replica_rebootstraps_total{replica="r0"} 2' in text
+    # exposition stays parseable with labeled + unlabeled families mixed
+    series = parse_prometheus_text(text)
+    assert len(series["skyline_replica_lag_ms"]) == 2
+
+
+def test_real_replica_exports_labeled_lag_on_shared_hub(tmp_path):
+    from skyline_tpu.resilience.wal import WalWriter
+    from skyline_tpu.serve import SnapshotStore, delta_wal_record
+    from skyline_tpu.serve.replica import SkylineReplica
+
+    d = str(tmp_path)
+    hub = Telemetry()
+    writer = WalWriter(d, fsync="off")
+    store = SnapshotStore()
+
+    def shadow(prev, snap):
+        writer.append(delta_wal_record(prev, snap))
+        writer.flush(force=True)
+
+    store.on_publish(shadow)
+    rng = np.random.default_rng(5)
+    for _ in range(4):
+        store.publish(rng.random((64, 3), dtype=np.float32))
+    replica = SkylineReplica(
+        d,
+        replica_id="rT",
+        poll_interval_s=0.001,
+        telemetry=hub,
+        primary_head_cb=lambda: store.head_version,
+    )
+    try:
+        assert replica.wait_for_version(store.head_version, timeout_s=30.0)
+        text = hub.render_prometheus()
+        series = parse_prometheus_text(text)
+        by_label = {
+            tuple(sorted(lbl.items())): v
+            for lbl, v in series["skyline_replica_head_version"]
+        }
+        assert by_label[(("replica", "rT"),)] == float(store.head_version)
+        assert (("replica", "rT"),) in {
+            tuple(sorted(lbl.items())): v
+            for lbl, v in series["skyline_replica_lag_versions"]
+        }
+        lag = {
+            tuple(sorted(lbl.items())): v
+            for lbl, v in series["skyline_replica_lag_versions"]
+        }[(("replica", "rT"),)]
+        assert lag == 0.0  # converged
+        assert "skyline_replica_records_applied_total" in series
+    finally:
+        replica.close()
+        writer.close()
+    # closing deregisters: a dead replica stops contributing series
+    assert "skyline_replica_head_version" not in parse_prometheus_text(
+        hub.render_prometheus()
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /ops and /cluster/overview
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_stats_server_serves_ops_and_overview(tmp_path):
+    from skyline_tpu.metrics.httpstats import StatsServer
+
+    d = str(tmp_path)
+    hub = Telemetry()
+    srv = StatsServer(lambda: {"ok": True}, port=0, telemetry=hub)
+    try:
+        # plane off: probe-friendly, not a 404
+        code, doc = _get(srv.port, "/ops")
+        assert code == 200 and doc == {"ok": True, "enabled": False}
+        ops = OpsLog(d, process_id="worker-http-1", fsync="off")
+        ops.record("promoted", epoch=2, holder="r0")
+        ops.record("demoted", epoch=1)
+        ops.flush(force=True)
+        hub.opslog = ops
+        code, doc = _get(srv.port, "/ops")
+        assert code == 200 and doc["total"] == 2
+        code, doc = _get(srv.port, "/ops?since_seq=1&limit=5")
+        assert [r["seq"] for r in doc["records"]] == [2]
+        # clusterview off: probe-friendly too
+        code, doc = _get(srv.port, "/cluster/overview")
+        assert code == 200 and doc["enabled"] is False
+        ops.close()
+    finally:
+        srv.close()
