@@ -309,6 +309,29 @@ def sorted_sfs_leg(cfg, ids, x, required) -> dict:
     return block
 
 
+def device_cascade_leg() -> dict:
+    """Device-cascade truth for the bench artifact (ISSUE 18): the
+    north-star-shaped flush A/B (quadratic SFS rounds vs the jit-safe
+    device cascade, digest identity asserted before any wall) plus the
+    profiler-auto leg proving ``choose_variant`` picks the winner from
+    measured EMAs. ``scripts/bench_compare.py`` gates the flush speedup;
+    the full grid lives in artifacts/device_cascade_ab.json."""
+    from benchmarks.sorted_sfs import bench_cascade_auto, bench_cascade_flush
+    from skyline_tpu.ops.dispatch import device_cascade_mode
+
+    flush = bench_cascade_flush(n=65536)
+    auto = bench_cascade_auto()
+    return {
+        "mode": device_cascade_mode(),
+        "flush_device_ms": flush["device_flush_ms"],
+        "flush_cascade_ms": flush["cascade_flush_ms"],
+        "flush_speedup": flush["speedup"],
+        "digest_identical": flush["digest_identical"],
+        "profiler_selects_cascade": auto["profiler_selects_cascade"],
+        "cascade_selected_signatures": auto["cascade_selected_signatures"],
+    }
+
+
 def sharded_leg(cfg, ids, x, required) -> dict:
     """Sharded-engine truth for the bench artifact (ISSUE 12): one
     ``ShardedEngine`` over the bench window — trigger twice (cold
@@ -953,6 +976,10 @@ def child_main(backend: str) -> None:
     except Exception as e:  # pragma: no cover - diagnostic path
         sorted_sfs = {"error": f"{type(e).__name__}: {e}"}
     try:
+        device_cascade = device_cascade_leg()
+    except Exception as e:  # pragma: no cover - diagnostic path
+        device_cascade = {"error": f"{type(e).__name__}: {e}"}
+    try:
         sharded = sharded_leg(
             cfg, ids, anti_correlated(rng, n, d, 0, 10000), required
         )
@@ -1015,6 +1042,7 @@ def child_main(backend: str) -> None:
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,
+                "device_cascade": device_cascade,
                 "resilience": resilience,
                 "failover": failover,
                 "merge_cache": merge_cache,

@@ -104,6 +104,14 @@ METRICS = (
     # number of the flush rewrite (BENCH_r06 0.98 -> r07 post-cascade), so
     # a creep back toward the quadratic kernels must fail the compare
     ("flush/merge_kernel share", _merge_kernel_share, False, False),
+    # device-cascade leg (ISSUE 18, bench.py device_cascade_leg): the
+    # north-star flush speedup of the jit-safe device cascade over the
+    # quadratic SFS rounds — the TPU/traced counterpart of the share gate
+    # above. Dropping toward 1.0 means the cascade (or its profiler
+    # arbitration) went dead and the flagship paths are quadratic again;
+    # absent (pre-cascade artifacts) skips, never fails
+    ("device_cascade.flush_speedup", ("device_cascade", "flush_speedup"),
+     True, False),
     # freshness SLI (bench.py serve_leg lineage block): read-lag p99 is the
     # end-to-end staleness readers actually saw — ingest event-time proxy
     # through flush/merge/publish to the /skyline response. Absent on older

@@ -229,6 +229,47 @@ def run(dims=DEFAULT_DIMS, n: int = 256) -> tuple[list[Finding], dict]:
                 os.environ["SKYLINE_SORTED_SFS"] = prev
         configs += 1
 
+    # device cascade (ISSUE 18): the jit-safe sorted dominance cascade is
+    # the one variant allowed to replace the quadratic kernels inside a
+    # trace, so it gets the full invariant battery at both mp settings —
+    # the f32 sum key must not smuggle in f64, the blocked scan must keep
+    # static shapes, and bf16 must appear iff the margin pre-drop is on.
+    from skyline_tpu.ops.device_cascade import _cascade_core
+
+    d_casc = max(dims)
+    if d_casc > 2:
+        x = jnp.asarray(rng.uniform(0, 1, (n, d_casc)).astype(np.float32))
+        valid = jnp.asarray(np.arange(n) < n - 3)
+        for mp in (False, True):
+            findings += _trace_twice(
+                lambda xx, vv: _cascade_core(
+                    xx, vv, block=64, mp=mp, use_pallas=False,
+                    interpret=False,
+                ),
+                (x, valid),
+                f"device_cascade_core d={d_casc} n={n} mp={int(mp)}",
+                expect_bf16=mp,
+            )
+            configs += 1
+
+        # forced-mode containment: with the cascade FORCED on, a traced
+        # skyline_mask_auto must lower to the cascade's pure device ops
+        # (same save/restore discipline as the sorted-SFS leg above)
+        prev = os.environ.get("SKYLINE_DEVICE_CASCADE")  # lint: allow-raw-env
+        os.environ["SKYLINE_DEVICE_CASCADE"] = "on"
+        try:
+            findings += _trace_twice(
+                lambda xx, vv: skyline_mask_auto(xx, vv), (x, valid),
+                f"skyline_mask_auto[device_cascade=on] d={d_casc} n={n}",
+                expect_bf16=False,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("SKYLINE_DEVICE_CASCADE", None)
+            else:
+                os.environ["SKYLINE_DEVICE_CASCADE"] = prev
+        configs += 1
+
     # SFS round + incremental merge step: the two flush hot ops, with the
     # mixed-precision knob toggled as the static arg the env gate threads
     for d in (min(dims), max(dims)):
@@ -285,6 +326,17 @@ def run(dims=DEFAULT_DIMS, n: int = 256) -> tuple[list[Finding], dict]:
         partition_summaries_device, mk, "partition_summaries_device"
     )
     configs += 2
+
+    def mk_cascade():
+        d = max(dims)
+        x = jnp.asarray(rng.uniform(0, 1, (128, d)).astype(np.float32))
+        valid = jnp.ones((128,), bool)
+        return (x, valid, 64, False, False, False)
+
+    findings += _cache_stability(
+        _cascade_core, mk_cascade, "device_cascade_core"
+    )
+    configs += 1
 
     summary = {
         "backend": jax.default_backend(),

@@ -153,6 +153,17 @@ KNOBS: tuple[Knob, ...] = (
        "max scan-block width of the sorted SFS cascade (the exact "
        "in-block pairwise tile; blocks start at 1024 and double up to "
        "this)", "engine", runbook="§2m"),
+    _k("SKYLINE_DEVICE_CASCADE", "enum", "auto",
+       "device-side sorted dominance cascade (jit-safe, TPU + traced "
+       "paths): auto (per-(d,N,backend,mp) choice from measured "
+       "KernelProfiler wall data), on (force the cascade, including "
+       "under trace), off (quadratic device kernels only)",
+       "engine", choices=("auto", "on", "off"), runbook="§2t"),
+    _k("SKYLINE_DEVICE_CASCADE_BLOCK", "int", 2048,
+       "scan block size of the device cascade (buffer chunks, in-block "
+       "pairwise tiles, and ambiguous-band tiles; rounded to a power of "
+       "two, floored at 1024 on the Pallas path)", "engine",
+       runbook="§2t"),
     # -- utils -------------------------------------------------------------
     _k("SKYLINE_COMPILE_CACHE", "str", None,
        "persistent XLA compilation cache directory (default: repo-local "

@@ -1,0 +1,298 @@
+"""Device-side sorted dominance cascade (fully traced, jit-safe).
+
+The host cascade (``ops/sorted_sfs.py``, RUNBOOK §2m) killed the quadratic
+flush kernels on concrete non-TPU inputs, but its tracer guard left every
+TPU / jitted path on the O(N²) SFS tiles. This module is the same
+sort-and-scan structure expressed in pure lax ops with static shapes, so it
+runs *inside* jit and on TPU:
+
+1.  **Fold** ``-0.0 -> +0.0`` on a selection-only copy (comparisons are
+    unaffected — ±0.0 compare equal — but equal tuples become bit-equal,
+    which the dedup needs). Rows that are invalid or contain NaN are
+    replaced wholesale with all-NaN: such rows never dominate and are never
+    dominated (every NaN comparison is False), NaN keys sort last, and the
+    padding rows need no separate handling.
+2.  **One sort** (``jnp.lexsort``) with the f32 row sum as the primary key
+    and the folded columns as tie-breakers — this yields the approximate
+    dominance order AND makes exact duplicates adjacent.
+3.  **Dedup** via adjacent-equal segment ids: only each segment's first row
+    (the *representative*) is a candidate; every other member inherits the
+    representative's fate at the end (duplicates survive or die together,
+    matching ``skyline_mask``).
+4.  **Blocked scan**: candidates stream through in sort order, each block
+    pruned against (a) the grow-only buffer of surviving representatives
+    from earlier blocks, (b) itself (full pairwise — see the radius note),
+    and (c) the *ambiguous band* of later blocks whose certified key range
+    overlaps this block's. Survivors append to the buffer.
+
+**The f32 error-radius argument.** f64 is unavailable on TPU, so the sort
+key is an f32 row sum, which is NOT exactly monotone under coordinate-wise
+≤: a dominator can sort strictly after its victim when rounding flips the
+key order. Instead of assuming exact ties we certify a per-row radius
+
+    r_i = (d - 1) * 2**-23 * sum_k |x_ik|
+
+which bounds |key_i − exact_sum_i|: a left-to-right f32 summation of d
+terms has first-order error ≤ (d−1)·u·Σ|x_k| with unit roundoff u = 2⁻²⁴,
+and doubling u to 2⁻²³ strictly absorbs the higher-order terms (valid for
+any d the hardware can hold) plus the rounding of r itself. If w dominates
+v then exact_sum(w) ≤ exact_sum(v), hence ``lo(w) = key−r ≤ hi(v) = key+r``
+— so scanning every later block j with ``min_j(lo) ≤ max_b(hi)`` (exact
+pairwise, rectangular tiles) catches every dominator the sort misplaced.
+NaN keys (mixed ±inf rows) take lo=−inf/hi=+inf, i.e. their block is never
+skipped; ±inf sums make r=+inf with the same effect. Nothing relies on
+fp monotonicity.
+
+The in-block self-prune deliberately uses the **full** (non-triangular)
+pairwise tile: the triangular skip assumes a dominator never sorts more
+than one tile after its victim, which equal-f32-key adversaries violate
+(see RUNBOOK §2t) — the widened band subsumes that assumption.
+
+**Why kills are sound**: a row is only ever dropped by exact strict
+dominance from a real valid non-NaN row (the bf16 pre-drop under ``mp``
+certifies a *subset* of true f32 dominance, RUNBOOK §2g). **Why the scan is
+complete**: every truly-dominated candidate v has a true-survivor dominator
+w (strict dominance is a strict partial order; follow the chain to a
+maximal element). w is never killed, so if w sorts in an earlier block it
+is in the buffer before v's block runs; same block → full self-prune;
+later block → the certified band above. Hence the output mask equals
+``skyline_mask`` exactly — byte-identity at mask, flush-append, and
+published-digest level.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skyline_tpu.ops.dominance import (
+    PAD_VALUE,
+    compact,
+    dominated_by,
+    strictly_dominated_bf16,
+)
+from skyline_tpu.utils.buckets import next_pow2
+
+# bf16 pre-drop prefix (mirrors ops.sfs._MP_PREFIX): under mp, each block
+# first drops rows certifiably dominated by the buffer's first rows —
+# the cheapest rows to be dominated by, since they have the smallest sums
+_MP_PREFIX = 512
+
+# bumped at Python trace time inside the jitted core — a witness that the
+# cascade really entered a jit trace (scripts/obs_smoke.sh asserts it goes
+# up exactly when a fresh (shape, config) signature compiles)
+_TRACE_COUNT = 0
+
+
+def cascade_trace_count() -> int:
+    """How many times the cascade core has been *traced* (not dispatched)."""
+    return _TRACE_COUNT
+
+
+def device_cascade_block() -> int:
+    """``SKYLINE_DEVICE_CASCADE_BLOCK``: scan block size, rounded to a
+    power of two (buffer chunks, self-prune tiles, and band tiles are all
+    this size). Default 2048 — one Pallas col-tile."""
+    from skyline_tpu.analysis.registry import env_int
+
+    b = env_int("SKYLINE_DEVICE_CASCADE_BLOCK", 2048)
+    return next_pow2(max(1, b), min_cap=8)
+
+
+def _rows_equal_prev(xs: jax.Array) -> jax.Array:
+    """eq[i] = row i equals row i-1 (NaN-aware: NaN slots match NaN slots;
+    eq[0] is meaningless and masked by the caller)."""
+    prev = jnp.roll(xs, 1, axis=0)
+    return jnp.all((xs == prev) | (jnp.isnan(xs) & jnp.isnan(prev)), axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "mp", "use_pallas", "interpret")
+)
+def _cascade_core(x, valid, block: int, mp: bool, use_pallas: bool,
+                  interpret: bool):
+    """Survivor mask over padded (n_pad, d) points; n_pad % block == 0."""
+    global _TRACE_COUNT
+    _TRACE_COUNT += 1
+    n_pad, d = x.shape
+    nb = n_pad // block
+
+    # selection-only copy: fold -0.0, neutralize invalid/NaN rows to
+    # all-NaN (never dominate, never dominated, sort last)
+    inert = ~valid | jnp.any(jnp.isnan(x), axis=1)
+    xc = jnp.where(inert[:, None], jnp.float32(jnp.nan),
+                   x + jnp.float32(0.0))
+
+    key = jnp.sum(xc, axis=1)
+    radius = jnp.float32((d - 1) * 2.0 ** -23) * jnp.sum(jnp.abs(xc), axis=1)
+    lo = key - radius
+    hi = key + radius
+    lo = jnp.where(jnp.isnan(lo), -jnp.inf, lo)
+    hi = jnp.where(jnp.isnan(hi), jnp.inf, hi)
+
+    # one sort: sum key primary (approximate dominance order), folded
+    # columns as tie-breakers (exact duplicates become adjacent)
+    perm = jnp.lexsort([xc[:, j] for j in range(d - 1, -1, -1)] + [key])
+    xs = xc[perm]
+    valid_s = valid[perm]
+    inert_s = inert[perm]
+
+    iota = jnp.arange(n_pad)
+    seg_start = (iota == 0) | ~_rows_equal_prev(xs)
+    rep_idx = lax.cummax(jnp.where(seg_start, iota, 0))
+    cand_ok = seg_start & ~inert_s
+    # non-candidates (duplicate members, inert rows) become all-NaN rows:
+    # dominance-neutral both ways, so the scan needs no validity vectors
+    cand = jnp.where(cand_ok[:, None], xs, jnp.float32(jnp.nan))
+    lo_s = jnp.where(cand_ok, lo[perm], jnp.inf)
+    hi_s = jnp.where(cand_ok, hi[perm], -jnp.inf)
+    block_lo = lo_s.reshape(nb, block).min(axis=1)
+    block_hi = hi_s.reshape(nb, block).max(axis=1)
+
+    prefix_n = min(_MP_PREFIX, n_pad)
+    ones_blk = jnp.ones((block,), dtype=bool)
+
+    if use_pallas:
+        from skyline_tpu.ops.pallas_dominance import (
+            dominated_by_any_pallas,
+            dominated_by_pallas,
+        )
+
+    def body(carry, b):
+        buf, count = carry
+        blk = lax.dynamic_slice(cand, (b * block, 0), (block, d))
+        alive = lax.dynamic_slice(cand_ok, (b * block,), (block,))
+
+        if mp:
+            # bf16 margin pre-drop against the buffer prefix (bit-exact:
+            # certified True is a proof of f32 strict dominance)
+            pref = lax.slice(buf, (0, 0), (prefix_n, d))
+            pv = jnp.arange(prefix_n) < count
+            alive = alive & ~strictly_dominated_bf16(blk, pref, x_valid=pv)
+
+        # (a) resident survivor buffer, chunked; empty chunks skipped
+        def chunk_body(c, alive):
+            start = c * block
+
+            def hit(a):
+                chunk = lax.dynamic_slice(buf, (start, 0), (block, d))
+                if use_pallas:
+                    cv = (start + jnp.arange(block)) < count
+                    dom = dominated_by_pallas(
+                        chunk.T, cv, blk.T, interpret=interpret, mp=mp
+                    )
+                else:
+                    # +inf fill rows never dominate; no validity needed
+                    dom = dominated_by(blk, chunk)
+                return a & ~dom
+
+            return lax.cond(start < count, hit, lambda a: a, alive)
+
+        alive = lax.fori_loop(0, nb, chunk_body, alive)
+
+        # (b) in-block: FULL pairwise — the triangular skip's "dominator
+        # within one tile" assumption fails under equal-f32-key collisions
+        if use_pallas:
+            dom_self = dominated_by_any_pallas(
+                blk.T, ones_blk, triangular=False, interpret=interpret,
+                mp=mp,
+            )
+        else:
+            dom_self = dominated_by(blk, blk)
+        alive = alive & ~dom_self
+
+        # (c) ambiguous band: later blocks whose certified lo range
+        # reaches back into this block's hi range (dominated rows acting
+        # as dominators are fine — dominance is transitive)
+        hi_b = block_hi[b]
+
+        def band_body(j, alive):
+            def hit(a):
+                blk_j = lax.dynamic_slice(cand, (j * block, 0), (block, d))
+                if use_pallas:
+                    dom = dominated_by_pallas(
+                        blk_j.T, ones_blk, blk.T, interpret=interpret,
+                        mp=mp,
+                    )
+                else:
+                    dom = dominated_by(blk, blk_j)
+                return a & ~dom
+
+            return lax.cond(block_lo[j] <= hi_b, hit, lambda a: a, alive)
+
+        alive = lax.fori_loop(b + 1, nb, band_body, alive)
+
+        # append surviving representatives (stable compaction keeps sort
+        # order; count + block <= n_pad since count <= b*block)
+        vals, _, cnt = compact(blk, alive, block)
+        buf = lax.dynamic_update_slice(buf, vals, (count, 0))
+        return (buf, count + cnt), alive
+
+    buf0 = jnp.full((n_pad, d), PAD_VALUE, dtype=xc.dtype)
+    (_, _), alive_blocks = lax.scan(
+        body, (buf0, jnp.int32(0)), jnp.arange(nb)
+    )
+    alive_all = alive_blocks.reshape(n_pad)
+    # members inherit their representative's fate; inert valid rows (NaN
+    # rows) survive unconditionally per the engine's semantics
+    keep_sorted = (alive_all[rep_idx] | inert_s) & valid_s
+    return jnp.zeros((n_pad,), dtype=bool).at[perm].set(keep_sorted)
+
+
+def device_cascade_mask(x, valid=None):
+    """Survivor mask via the device cascade — semantically identical to
+    ``skyline_mask`` / ``skyline_mask_auto`` (same rows, same order, the
+    mask indexes the ORIGINAL row order). Safe to call on tracers: every
+    step is lax ops over static shapes."""
+    n, d = x.shape
+    if n == 0:
+        return jnp.zeros((0,), dtype=bool)
+    from skyline_tpu.ops.dispatch import mixed_precision_enabled, on_tpu
+    from skyline_tpu.ops.sfs import pallas_interpret
+
+    interpret = bool(pallas_interpret())
+    use_pallas = on_tpu() or interpret
+    mp = mixed_precision_enabled()
+    # Pallas tiles need lane-aligned blocks; the pure-jnp path can afford
+    # small blocks (the band-widening soundness test forces tiny ones)
+    blk = device_cascade_block()
+    if use_pallas:
+        blk = max(blk, 1024)
+    n_pad = next_pow2(n, min_cap=1024 if use_pallas else 64)
+    blk = min(blk, n_pad)
+    x = jnp.asarray(x, dtype=jnp.float32)
+    if valid is None:
+        valid = jnp.ones((n,), dtype=bool)
+    if n_pad != n:
+        x = jnp.concatenate(
+            [x, jnp.full((n_pad - n, d), PAD_VALUE, dtype=jnp.float32)]
+        )
+        valid = jnp.concatenate(
+            [valid, jnp.zeros((n_pad - n,), dtype=bool)]
+        )
+    keep = _cascade_core(
+        x, valid, block=blk, mp=mp, use_pallas=use_pallas,
+        interpret=interpret,
+    )
+    return keep[:n]
+
+
+def device_cascade_keep(rows, old):
+    """Survivor mask of ``rows`` against a resident skyline ``old`` —
+    survivors of ``old ∪ rows`` restricted to ``rows``, the exact set the
+    device ``sfs_round`` appends (same contract as ``sorted_sfs_keep``,
+    computed on device instead of host NumPy). Host in, host out."""
+    import numpy as np
+
+    rows = np.asarray(rows, dtype=np.float32)
+    old = np.asarray(old, dtype=np.float32)
+    if rows.shape[0] == 0:
+        return np.zeros((0,), dtype=bool)
+    if old.shape[0] == 0:
+        return np.asarray(device_cascade_mask(jnp.asarray(rows)))
+    union = np.concatenate([old, rows], axis=0)
+    keep = np.asarray(device_cascade_mask(jnp.asarray(union)))
+    return keep[old.shape[0]:]

@@ -369,23 +369,62 @@ def sorted_sfs_mode() -> str:
     return v if v in ("auto", "on", "off") else "auto"
 
 
+def device_cascade_mode() -> str:
+    """``SKYLINE_DEVICE_CASCADE``: the device-side sorted dominance
+    cascade (``ops/device_cascade.py`` — on-device dedup + f32 sum-key
+    sort with a certified error radius + blocked buffer/band scans;
+    byte-identical masks, see RUNBOOK §2t). Unlike the §2m host cascade
+    it is pure lax over static shapes, so it applies ON TPU and INSIDE
+    jit. ``auto`` (default) picks per (variant, d, N-bucket, backend,
+    mp) signature from measured KernelProfiler wall data — concrete
+    calls explore and record, traced call sites only swap it in on
+    existing measured evidence (nothing records under a tracer); ``on``
+    forces the cascade everywhere including under trace; ``off`` keeps
+    the quadratic device kernels. Read lazily per call (trace time for
+    jitted callers)."""
+    from skyline_tpu.analysis.registry import env_str
+
+    v = env_str("SKYLINE_DEVICE_CASCADE", "auto")
+    return v if v in ("auto", "on", "off") else "auto"
+
+
 def choose_variant(profiler, candidates, d: int, n: int, mp: bool = False):
     """Profiler-driven dispatch: pick among ``candidates`` (variant-name
     strings, preference-ordered) under signature (d, N-bucket, backend).
 
     Any candidate without measured wall data runs next (first listed
     wins), so each variant seeds its EMA exactly once per signature;
-    after that the minimum EMA wins every time. With no profiler at all,
-    the first candidate is the standing choice."""
+    after that the minimum EMA wins every time. Exploration is
+    per-signature STICKY (``KernelProfiler.claim_explore``): the first
+    caller to claim an unmeasured candidate runs it; until its record
+    lands, other calls under the same signature fall back to the best
+    measured candidate instead of re-paying the cold path — adding a new
+    candidate row can no longer stall a hot flush loop repeatedly. With
+    no profiler at all, the first candidate is the standing choice."""
     if profiler is None:
         return candidates[0]
+    claim = getattr(profiler, "claim_explore", None)
     emas = []
+    unmeasured = []
     for c in candidates:
         e = profiler.ema_ms(c, d, n, mp)
         if e is None:
-            return c  # unmeasured: explore it now, choose on data after
-        emas.append((e, c))
-    return min(emas)[1]
+            unmeasured.append(c)
+        else:
+            emas.append((e, c))
+    if not unmeasured:
+        return min(emas)[1]
+    if claim is None:
+        # foreign profiler without the claim API: legacy explore-first
+        return unmeasured[0]
+    for c in unmeasured:
+        if claim(c, d, n, mp):
+            return c
+    # every unmeasured candidate is already claimed by an in-flight
+    # exploration: serve measured data rather than stalling again
+    if emas:
+        return min(emas)[1]
+    return candidates[0]
 
 
 # the profiler skyline_mask_auto's host-path records into / chooses from;
@@ -427,23 +466,71 @@ def skyline_mask_auto(x, valid=None):
         from skyline_tpu.ops.sweep2d import skyline_mask_sweep
 
         return skyline_mask_sweep(x, valid)
+    dc_mode = device_cascade_mode()
     if on_tpu():
         from skyline_tpu.ops.pallas_dominance import (
             skyline_mask_pallas,
             skyline_mask_rank_pallas,
         )
 
-        if rank_cascade():
-            return skyline_mask_rank_pallas(x, valid)
-        return skyline_mask_pallas(x, valid)
+        def _pallas_mask(x, valid):
+            if rank_cascade():
+                return skyline_mask_rank_pallas(x, valid)
+            return skyline_mask_pallas(x, valid)
+
+        if dc_mode == "off":
+            return _pallas_mask(x, valid)
+        from skyline_tpu.ops.device_cascade import device_cascade_mask
+
+        if dc_mode == "on":
+            return device_cascade_mask(x, valid)
+        # auto: quadratic Pallas tiles vs the device cascade, per
+        # (variant, d, N-bucket, backend, mp) signature. Concrete calls
+        # explore + record (synced for honest walls); traced call sites
+        # cannot record, so they only swap the cascade in once BOTH
+        # candidates carry measured evidence and the cascade wins.
+        n, d = x.shape
+        prof = _mask_profiler()
+        mp = mixed_precision_enabled()
+        device_variant = (
+            "mask_rank_pallas" if rank_cascade() else "mask_pallas"
+        )
+        if _is_concrete(x) and (valid is None or _is_concrete(valid)):
+            variant = choose_variant(
+                prof, (device_variant, "mask_device_cascade"), d, n, mp
+            )
+            if variant == "mask_device_cascade":
+                with prof.record("mask_device_cascade", d, n, mp):
+                    out = device_cascade_mask(x, valid)
+                    out.block_until_ready()
+                return out
+            with prof.record(device_variant, d, n, mp):
+                out = _pallas_mask(x, valid)
+                out.block_until_ready()  # honest wall for the EMA compare
+            return out
+        e_dev = prof.ema_ms(device_variant, d, n, mp)
+        e_dc = prof.ema_ms("mask_device_cascade", d, n, mp)
+        if e_dev is not None and e_dc is not None and e_dc < e_dev:
+            return device_cascade_mask(x, valid)
+        return _pallas_mask(x, valid)
     from skyline_tpu.ops.block_skyline import skyline_mask_scan
 
-    # d > 2 off-TPU: sorted-order SFS host cascade vs the scan kernel,
-    # chosen per (d, N, backend) from measured profiler wall data. Only
-    # for concrete arrays — under tracing (jit bodies, the jaxpr audit)
-    # the device kernel is the only sound choice.
+    # d > 2 off-TPU: sorted-order SFS host cascade vs the scan kernel vs
+    # the device cascade, chosen per (d, N, backend) from measured
+    # profiler wall data. The host cascade only applies to concrete
+    # arrays — under tracing (jit bodies, the jaxpr audit) the traced
+    # candidates are the scan kernel and (when forced on) the device
+    # cascade, which is pure lax over static shapes.
     mode = sorted_sfs_mode()
-    if mode != "off" and _is_concrete(x) and (valid is None or _is_concrete(valid)):
+    concrete = _is_concrete(x) and (valid is None or _is_concrete(valid))
+    if not concrete:
+        if dc_mode == "on":
+            from skyline_tpu.ops.device_cascade import device_cascade_mask
+
+            return device_cascade_mask(x, valid)
+        return skyline_mask_scan(x, valid)
+    if mode == "on" or (mode != "off" and dc_mode == "off"):
+        # forced host cascade, or the historical two-way host race
         import jax.numpy as jnp
         import numpy as np
 
@@ -469,7 +556,43 @@ def skyline_mask_auto(x, valid=None):
             out = skyline_mask_scan(x, valid)
             out.block_until_ready()  # honest wall for the EMA compare
         return out
-    return skyline_mask_scan(x, valid)
+    from skyline_tpu.ops.device_cascade import device_cascade_mask
+
+    if dc_mode == "on":
+        return device_cascade_mask(x, valid)
+    if mode == "off" and dc_mode == "off":
+        return skyline_mask_scan(x, valid)
+    candidates = []
+    if mode != "off":
+        candidates.append("sorted_sfs_mask")
+    candidates.append("mask_scan")
+    candidates.append("mask_device_cascade")
+    n, d = x.shape
+    prof = _mask_profiler()
+    variant = choose_variant(prof, tuple(candidates), d, n)
+    if variant == "sorted_sfs_mask":
+        import jax.numpy as jnp
+        import numpy as np
+
+        from skyline_tpu.ops.sorted_sfs import sorted_skyline_mask_np
+
+        with prof.record("sorted_sfs_mask", d, n):
+            out = jnp.asarray(
+                sorted_skyline_mask_np(
+                    np.asarray(x),
+                    None if valid is None else np.asarray(valid),
+                )
+            )
+        return out
+    if variant == "mask_device_cascade":
+        with prof.record("mask_device_cascade", d, n):
+            out = device_cascade_mask(x, valid)
+            out.block_until_ready()
+        return out
+    with prof.record("mask_scan", d, n):
+        out = skyline_mask_scan(x, valid)
+        out.block_until_ready()  # honest wall for the EMA compare
+    return out
 
 
 def skyline_keep_np(x):

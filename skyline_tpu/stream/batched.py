@@ -38,6 +38,7 @@ from skyline_tpu.resilience.faults import fault_point
 from skyline_tpu.ops.dispatch import (
     choose_variant,
     delta_dirty_cutoff,
+    device_cascade_mode,
     flush_prefilter_enabled,
     flush_stage_depth,
     merge_cache_enabled,
@@ -1301,6 +1302,12 @@ class PartitionSet:
                 "flush_sorted_sfs", self.dims, total_rows
             ):
                 counts = self._sfs_sorted_host(rows)
+        elif path == "device_cascade":
+            self._inc("flush.device_cascade")
+            with self._flush_prof.record(
+                "flush_device_cascade", self.dims, total_rows
+            ):
+                counts = self._sfs_device_cascade(rows)
         elif self._flush_prof is not None:
             # chooser active: time the device flush end to end (counts
             # sync included) so the EMA compare is honest
@@ -1333,11 +1340,19 @@ class PartitionSet:
         backend) signature and the measured EMA decides thereafter
         (``dispatch.choose_variant``; the sorted path explores first). The
         host path needs concrete host rows, so meshes and TPU backends
-        always keep the device variant."""
-        if self.mesh is not None or on_tpu():
+        never list it; the DEVICE cascade (``ops/device_cascade.py``,
+        ISSUE 18) is jit-safe and joins the candidate row whenever the
+        host cascade is OUT of play (TPU, or ``SKYLINE_SORTED_SFS=off``)
+        — on host backends with the sorted cascade available, the device
+        cascade loses to it at every measured signature, so listing it
+        would make every fresh engine pay a losing exploration flush for
+        nothing (``SKYLINE_DEVICE_CASCADE=on`` still forces it anywhere
+        for A/B). Meshed flushes stay on the shard_map SFS rounds."""
+        if self.mesh is not None:
             return device_variant
-        mode = sorted_sfs_mode()
-        if mode == "off":
+        mode = sorted_sfs_mode() if not on_tpu() else "off"
+        dc_mode = device_cascade_mode()
+        if mode == "off" and dc_mode == "off":
             return device_variant
         if self._flush_prof is None:
             from skyline_tpu.telemetry.profiler import KernelProfiler
@@ -1345,13 +1360,22 @@ class PartitionSet:
             self._flush_prof = KernelProfiler()
         if mode == "on":
             return "sorted_sfs"
+        if dc_mode == "on":
+            return "device_cascade"
+        candidates = []
+        if mode != "off":
+            candidates.append("flush_sorted_sfs")
+        candidates.append("flush_sfs_" + device_variant)
+        if dc_mode != "off" and mode == "off":
+            candidates.append("flush_device_cascade")
         chosen = choose_variant(
-            self._flush_prof,
-            ("flush_sorted_sfs", "flush_sfs_" + device_variant),
-            self.dims,
-            total_rows,
+            self._flush_prof, tuple(candidates), self.dims, total_rows
         )
-        return "sorted_sfs" if chosen == "flush_sorted_sfs" else device_variant
+        if chosen == "flush_sorted_sfs":
+            return "sorted_sfs"
+        if chosen == "flush_device_cascade":
+            return "device_cascade"
+        return device_variant
 
     def _sfs_sorted_host(self, rows: list[np.ndarray]):
         """Host sorted-order SFS flush: per partition, take the exact
@@ -1383,6 +1407,58 @@ class PartitionSet:
                     "sorted_sfs", old_n + rp.shape[0]
                 ):
                     keep = sorted_sfs_keep(rp, old)
+                surv = rp[keep]
+                need = old_n + surv.shape[0]
+                cap_p = max(sky_p.shape[0], _next_pow2(max(need, 1)))
+                with self.tracer.phase("flush/assemble"):
+                    buf = np.full(
+                        (cap_p, self.dims), np.inf, dtype=np.float32
+                    )
+                    if old_n:
+                        buf[:old_n] = old
+                    buf[old_n:need] = surv
+                with self.tracer.phase("flush/device_put"):
+                    sky_p = jnp.asarray(buf)
+                    cnt_p = jnp.asarray(np.int32(need))
+                self._count_ub[p] = need
+            new_skies.append(sky_p)
+            new_counts.append(cnt_p)
+        return self._restack_skies(new_skies, new_counts)
+
+    def _sfs_device_cascade(self, rows: list[np.ndarray]):
+        """Device-cascade flush: same per-partition shape as
+        ``_sfs_sorted_host`` — exact survivor mask of old ∪ new, new
+        survivors appended after the old prefix in arrival order — but
+        the mask comes from the jit-compiled sorted dominance cascade
+        (``ops/device_cascade.py``), so the merge kernel runs on the
+        accelerator instead of a host numpy scan. Byte-identical state
+        by the same argument: the cascade only selects, never reorders,
+        and the old prefix always survives the union (old rows are
+        mutually non-dominated and new rows arrive pre-screened)."""
+        from skyline_tpu.ops.device_cascade import device_cascade_keep
+
+        if not int(self._count_ub.max()):
+            counts_host = np.zeros(self.num_partitions, dtype=np.int64)
+        else:
+            counts_host = self.sky_counts().astype(np.int64)
+        new_skies = []
+        new_counts = []
+        for p in range(self.num_partitions):
+            rp = rows[p]
+            sky_p = self.sky[p]
+            cnt_p = self._count_dev[p]
+            old_n = int(counts_host[p])
+            if rp.shape[0]:
+                with self.tracer.phase("flush/assemble"):
+                    old = (
+                        np.asarray(sky_p[:old_n])
+                        if old_n
+                        else np.empty((0, self.dims), dtype=np.float32)
+                    )
+                with self.tracer.phase("flush/merge_kernel"), self._kernel(
+                    "device_cascade", old_n + rp.shape[0]
+                ):
+                    keep = device_cascade_keep(rp, old)
                 surv = rp[keep]
                 need = old_n + surv.shape[0]
                 cap_p = max(sky_p.shape[0], _next_pow2(max(need, 1)))

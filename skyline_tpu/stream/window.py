@@ -59,14 +59,23 @@ KERNEL_VARIANTS = {
     "sfs_cleanup": "lazy-flush cleanup pass",
     "sorted_sfs": "host sorted-order SFS cascade, one partition's flush "
                   "(ops/sorted_sfs.py: dedup + f64 sum-sort + blocked scan)",
+    "device_cascade": "device sorted dominance cascade, one partition's "
+                      "flush (ops/device_cascade.py: on-device dedup + f32 "
+                      "sum-key sort + blocked prefix/band scan, jit-safe)",
     # dispatch-chooser signatures (recorded into PartitionSet._flush_prof
     # and dispatch._MASK_PROFILER, not the engine profiler — whole-path
     # aggregates that would double-count the per-round rows above)
     "flush_sorted_sfs": "whole lazy flush via the host sorted cascade",
     "flush_sfs_sequential": "whole lazy flush via per-partition SFS rounds",
     "flush_sfs_vmapped": "whole lazy flush via vmapped SFS rounds",
+    "flush_device_cascade": "whole lazy flush via the device sorted "
+                            "dominance cascade",
     "sorted_sfs_mask": "skyline_mask_auto host path (concrete non-TPU d>2)",
     "mask_scan": "skyline_mask_auto device scan kernel (concrete arrays)",
+    "mask_device_cascade": "skyline_mask_auto device sorted dominance "
+                           "cascade (jit-safe, all backends)",
+    "mask_pallas": "skyline_mask_auto Pallas sum-sorted tiles (TPU)",
+    "mask_rank_pallas": "skyline_mask_auto Pallas rank-cascade tiles (TPU)",
 }
 
 # Minimum buffer capacity. Power-of-two buckets >= this always divide the

@@ -73,6 +73,7 @@ class KernelProfiler:
     def __init__(self, spans=None, backend: str | None = None):
         self._lock = threading.Lock()
         self._entries: dict[tuple, _Entry] = {}  # guarded-by: self._lock
+        self._claimed: set[tuple] = set()  # guarded-by: self._lock
         self.spans = spans  # optional SpanRecorder for kernel slices
         self._backend = backend
         self.dispatches = 0  # guarded-by: self._lock
@@ -154,6 +155,26 @@ class KernelProfiler:
             if isinstance(v, (int, float)):
                 out[k.replace(" ", "_")] = float(v)
         return out or None
+
+    def claim_explore(
+        self, variant: str, d: int, n: int, mp: bool = False
+    ) -> bool:
+        """One-shot exploration claim for signature (variant, d,
+        bucket(n), backend, mp): returns True exactly once while the
+        signature has no measured data — ``dispatch.choose_variant``'s
+        sticky-explore handshake. Without it, every call between the
+        first dispatch of an unmeasured candidate and its record landing
+        re-runs the cold path (compile + first-run wall) on a hot loop;
+        with it, the second caller immediately falls back to measured
+        data. A signature that records later keeps winning or losing on
+        its EMA as usual; a claim whose dispatch never records leaves
+        the candidate unexplored by design (no retry storms)."""
+        key = (variant, int(d), n_bucket(n), self._backend_name(), bool(mp))
+        with self._lock:
+            if key in self._entries or key in self._claimed:
+                return False
+            self._claimed.add(key)
+            return True
 
     def ema_ms(self, variant: str, d: int, n: int, mp: bool = False):
         """EMA wall of one signature, or None if it never dispatched —

@@ -80,9 +80,10 @@ def test_jaxpr_audit_clean_on_tree():
     findings, summary = jaxpr_audit.run()
     assert findings == [], "\n".join(f.format() for f in findings)
     assert summary["dims"] == [2, 4, 8]
-    # the full matrix: 3 mask dims + 1 sorted-SFS containment leg + 2 dims
-    # x 2 mp x 2 ops + 2 dims x 2 summary kernels + 2 cache-stability legs
-    assert summary["configs_traced"] == 18
+    # the full matrix: 3 mask dims + 1 sorted-SFS containment leg + 2
+    # device-cascade mp legs + 1 device-cascade containment leg + 2 dims
+    # x 2 mp x 2 ops + 2 dims x 2 summary kernels + 3 cache-stability legs
+    assert summary["configs_traced"] == 22
 
 
 def test_cli_exits_zero_on_tree():

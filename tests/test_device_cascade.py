@@ -1,0 +1,389 @@
+"""Device-side sorted dominance cascade (ISSUE 18): the jit-safe cascade
+must be byte-identical to the quadratic device kernels at every level —
+raw mask (concrete AND traced), union keep, engine flush, published
+digest — plus the f32 sum-key error-radius soundness property, the
+sticky-explore dispatch handshake, and the trace-count witness that the
+cascade really compiles inside jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skyline_tpu.ops import device_cascade as dc
+from skyline_tpu.ops.device_cascade import (
+    cascade_trace_count,
+    device_cascade_keep,
+    device_cascade_mask,
+)
+from skyline_tpu.ops.dispatch import (
+    choose_variant,
+    device_cascade_mode,
+    skyline_mask_auto,
+)
+from skyline_tpu.ops.dominance import skyline_mask
+from skyline_tpu.ops.sorted_sfs import sorted_sfs_keep
+from skyline_tpu.stream.batched import PartitionSet
+
+# shared via conftest.py
+from conftest import assert_same_merge, fill_pset, gen_points, merge_state
+
+# ---------------------------------------------------------------------------
+# mask-level parity: device cascade vs the quadratic referee
+# ---------------------------------------------------------------------------
+
+
+def _referee(x, valid=None):
+    return np.asarray(skyline_mask(jnp.asarray(x), valid))
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_mask_parity_grid(rng, kind, d):
+    """Concrete AND jitted masks across the workload grid, with injected
+    duplicates so the dedup path is always live."""
+    x = gen_points(rng, 600, d, kind)
+    x = np.concatenate([x, x[:37]])  # duplicates of real rows
+    want = _referee(x)
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    assert np.array_equal(got, want), (kind, d, "concrete")
+    jitted = np.asarray(jax.jit(device_cascade_mask)(jnp.asarray(x)))
+    assert np.array_equal(jitted, want), (kind, d, "jit")
+
+
+def test_mask_parity_with_valid(rng):
+    x = gen_points(rng, 400, 4, "uniform")
+    valid = rng.random(400) < 0.7
+    got = np.asarray(device_cascade_mask(jnp.asarray(x), jnp.asarray(valid)))
+    want = _referee(x, jnp.asarray(valid))
+    assert np.array_equal(got, want)
+    assert not got[~valid].any()
+
+
+ADVERSARIAL = {
+    "duplicates": np.repeat(
+        np.array([[1, 9], [9, 1], [5, 5], [2, 8]], np.float32), 16, axis=0
+    ),
+    "zero-clump": np.concatenate([
+        np.zeros((256, 4), np.float32),
+        np.full((32, 4), 3.0, np.float32),
+    ]),
+    "equal-sums": np.array(
+        [[0, 3], [1, 2], [2, 1], [3, 0], [1.5, 1.5]], np.float32
+    ).repeat(8, axis=0),
+    "nan-inf": np.array(
+        [
+            [1, 1, 1],
+            [np.nan, 0, 0],
+            [np.inf, np.inf, np.inf],
+            [0, np.nan, np.nan],
+            [2, 2, 2],
+            [np.inf, 0, 0],
+        ],
+        np.float32,
+    ),
+    # mixed +/- inf rows have NaN row sums: lo/hi become -inf/+inf, so
+    # their block is never band-skipped
+    "mixed-inf": np.array(
+        [
+            [np.inf, -np.inf, 0],
+            [-np.inf, np.inf, 0],
+            [-np.inf, -np.inf, -np.inf],
+            [0, 0, 0],
+            [np.inf, -np.inf, 1],
+        ],
+        np.float32,
+    ),
+    "signed-zero": np.array(
+        [[-0.0, 0.0], [0.0, -0.0], [0.0, 0.0], [1.0, 1.0]], np.float32
+    ),
+    "single": np.array([[4, 2, 7]], np.float32),
+    "empty": np.zeros((0, 5), np.float32),
+}
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_mask_parity_adversarial(case):
+    x = ADVERSARIAL[case]
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    want = _referee(x)
+    assert np.array_equal(got, want), case
+    # byte-for-byte on the selected rows (the -0.0 fold is selection-only)
+    assert x[got].tobytes() == x[want].tobytes(), case
+
+
+def test_valid_nan_rows_survive(rng):
+    """All-NaN and partial-NaN valid rows are dominance-neutral and must
+    survive — the `| inert_s` leg of the final mask."""
+    x = gen_points(rng, 64, 3, "uniform")
+    x[10] = np.nan
+    x[20, 1] = np.nan
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    assert got[10] and got[20]
+    assert np.array_equal(got, _referee(x))
+
+
+# ---------------------------------------------------------------------------
+# f32 sum-key error radius: soundness property + equal-key band adversary
+# ---------------------------------------------------------------------------
+
+
+def test_radius_bounds_f32_key_error(rng):
+    """|f32 row-sum key − exact (f64) sum| ≤ r = (d−1)·2⁻²³·Σ|x| for every
+    row — the certificate the band scan's lo/hi ranges ride on."""
+    for d in (2, 4, 8):
+        x = (gen_points(rng, 2048, d, "anti") - 0.5) * np.float32(1e6)
+        key = np.asarray(jnp.sum(jnp.asarray(x), axis=1), np.float64)
+        exact = np.sum(x.astype(np.float64), axis=1)
+        radius = np.asarray(
+            jnp.float32((d - 1) * 2.0 ** -23)
+            * jnp.sum(jnp.abs(jnp.asarray(x)), axis=1),
+            np.float64,
+        )
+        assert (np.abs(key - exact) <= radius).all(), d
+
+
+def test_equal_key_multi_block_band(monkeypatch):
+    """Every row shares the exact f32 sum key 2^24 while spanning several
+    scan blocks (block=8): the sort key gives the scan nothing, the band
+    condition fires across all block pairs, and identity must still hold.
+    fl(2^24 + c) == 2^24 for c < 1, so the three trailing rows tie the
+    key with strictly different exact sums — the radius must keep their
+    blocks mutually ambiguous."""
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE_BLOCK", "8")
+    base = 16777216.0  # 2^24
+    rows = [(base - j, float(j)) for j in range(2, 22)]
+    rows += [(base, 0.5), (base, 1.0), (base, 0.75)]
+    x = np.array(rows, np.float32)
+    key = np.asarray(jnp.sum(jnp.asarray(x), axis=1))
+    assert (key == np.float32(base)).all()  # the whole input is one band
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    assert np.array_equal(got, _referee(x))
+    assert got[20] and not got[21] and not got[22]
+
+
+# ---------------------------------------------------------------------------
+# union keep: the flush-path primitive
+# ---------------------------------------------------------------------------
+
+
+def test_keep_union_semantics(rng):
+    for d in (3, 6):
+        old = gen_points(rng, 200, d, "anti")
+        old = old[_referee(old)]  # a real skyline prefix
+        rows = gen_points(rng, 300, d, "uniform")
+        keep = device_cascade_keep(rows, old)
+        union = np.concatenate([old, rows])
+        want = _referee(union)[old.shape[0]:]
+        assert np.array_equal(keep, want), d
+        assert np.array_equal(keep, sorted_sfs_keep(rows, old)), d
+
+
+def test_keep_empty_old(rng):
+    rows = gen_points(rng, 150, 4, "uniform")
+    keep = device_cascade_keep(rows, np.empty((0, 4), np.float32))
+    assert np.array_equal(
+        keep, np.asarray(device_cascade_mask(jnp.asarray(rows)))
+    )
+
+
+def test_keep_duplicate_of_old_survives():
+    old = np.array([[1, 1]], np.float32)
+    rows = np.array([[1, 1], [2, 2]], np.float32)
+    keep = device_cascade_keep(rows, old)
+    assert keep[0] and not keep[1]
+
+
+# ---------------------------------------------------------------------------
+# engine-level byte identity through the flush + published merge digest
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti"])
+@pytest.mark.parametrize("d", [2, 4, 8])
+@pytest.mark.parametrize("policy", ["incremental", "lazy", "overlap"])
+def test_engine_byte_identity(monkeypatch, kind, d, policy):
+    """The knob must never change a published byte: global merge digest
+    (count, survivor vector, point bytes) identical across off/on/auto.
+    The sorted-SFS knob is pinned off so the matrix isolates the device
+    cascade's own arbitration."""
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    states = {}
+    for mode in ("off", "on", "auto"):
+        monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", mode)
+        rng = np.random.default_rng(37)
+        pset = PartitionSet(3, d, flush_policy=policy)
+        fill_pset(pset, rng, gen_points(rng, 384, d, kind), 3)
+        states[mode] = merge_state(pset)
+    assert_same_merge(states["off"], states["on"], f"{kind}/{d}/{policy}")
+    assert_same_merge(states["off"], states["auto"], f"{kind}/{d}/{policy}")
+
+
+def test_engine_byte_identity_both_auto(monkeypatch):
+    """Both cascades in auto: the live flush arbitration (host cascade +
+    quadratic rounds on this backend; the device cascade only joins the
+    row when the host cascade is out of play — see _choose_lazy_path)
+    must still publish the same bytes as everything off."""
+    states = {}
+    for sorted_mode, dc_mode in (("off", "off"), ("auto", "auto")):
+        monkeypatch.setenv("SKYLINE_SORTED_SFS", sorted_mode)
+        monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", dc_mode)
+        rng = np.random.default_rng(11)
+        pset = PartitionSet(3, 6, flush_policy="lazy")
+        fill_pset(pset, rng, gen_points(rng, 512, 6, "anti"), 3)
+        states[(sorted_mode, dc_mode)] = merge_state(pset)
+    assert_same_merge(
+        states[("off", "off")], states[("auto", "auto")], "both-auto"
+    )
+
+
+def test_engine_flush_counter(monkeypatch):
+    """Forced on, a lazy flush must actually take the cascade path
+    (flush.device_cascade counter + the profiler signature)."""
+    from skyline_tpu.telemetry import Telemetry
+
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "on")
+    tel = Telemetry()
+    rng = np.random.default_rng(5)
+    pset = PartitionSet(2, 4, flush_policy="lazy", counters=tel.counters)
+    fill_pset(pset, rng, gen_points(rng, 400, 4, "anti"), 2)
+    counters = dict(tel.counters.snapshot())
+    assert counters.get("flush.device_cascade", 0) > 0
+    variants = {r["variant"] for r in pset._flush_prof.doc()["kernels"]}
+    assert "flush_device_cascade" in variants
+
+
+# ---------------------------------------------------------------------------
+# dispatch gate: knob, forced-on identity, trace behavior, Pallas path
+# ---------------------------------------------------------------------------
+
+
+def test_mode_knob(monkeypatch):
+    monkeypatch.delenv("SKYLINE_DEVICE_CASCADE", raising=False)
+    assert device_cascade_mode() == "auto"
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "off")
+    assert device_cascade_mode() == "off"
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "bogus")
+    assert device_cascade_mode() == "auto"
+
+
+def test_dispatch_forced_on_matches_off(monkeypatch, rng):
+    x = jnp.asarray(gen_points(rng, 300, 5, "anti"))
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "off")
+    off = np.asarray(skyline_mask_auto(x))
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "on")
+    on = np.asarray(skyline_mask_auto(x))
+    assert np.array_equal(off, on)
+
+
+def test_traced_dispatch_forced_on(monkeypatch, rng):
+    """Unlike the host cascade, dc=on holds INSIDE jit: the traced auto
+    mask must route to the cascade and still match the referee."""
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "on")
+    x = jnp.asarray(gen_points(rng, 200, 4, "uniform"))
+    got = np.asarray(jax.jit(skyline_mask_auto)(x))
+    assert np.array_equal(got, _referee(np.asarray(x)))
+
+
+def test_trace_count_witness(rng):
+    """Jitting the cascade over a fresh shape must bump the Python-side
+    trace counter exactly at compile time — the LIVE-under-jit witness
+    obs_smoke.sh leans on."""
+    x = jnp.asarray(gen_points(rng, 97, 7, "uniform"))
+    before = cascade_trace_count()
+    first = np.asarray(jax.jit(device_cascade_mask)(x))
+    after_compile = cascade_trace_count()
+    assert after_compile > before
+    again = np.asarray(jax.jit(device_cascade_mask)(x))
+    assert cascade_trace_count() == after_compile  # cached: no retrace
+    assert np.array_equal(first, again)
+
+
+def test_pallas_interpret_parity(monkeypatch, rng):
+    """SKYLINE_PALLAS_INTERPRET=1 drives the cascade's Pallas tile path
+    (buffer chunks, full self-prune, band tiles) on CPU."""
+    monkeypatch.setenv("SKYLINE_PALLAS_INTERPRET", "1")
+    x = gen_points(rng, 300, 4, "anti")
+    x = np.concatenate([x, x[:16]])
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    assert np.array_equal(got, _referee(x))
+
+
+def test_mixed_precision_bit_exact(monkeypatch, rng):
+    """The mp bf16 pre-drop only certifies a subset of true dominance:
+    masks stay bit-identical with the margin pass on."""
+    x = gen_points(rng, 500, 6, "anti")
+    want = _referee(x)
+    monkeypatch.setenv("SKYLINE_MIXED_PRECISION", "1")
+    got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+    assert np.array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# sticky exploration: the claim handshake + chooser sequencing
+# ---------------------------------------------------------------------------
+
+
+def test_claim_explore_one_shot():
+    from skyline_tpu.telemetry.profiler import KernelProfiler
+
+    prof = KernelProfiler(backend="cpu")
+    assert prof.claim_explore("v", 4, 100)
+    assert not prof.claim_explore("v", 4, 100)  # claimed, not recorded
+    assert prof.claim_explore("v", 4, 100_000)  # different N-bucket
+    with prof.record("w", 4, 100):
+        pass
+    assert not prof.claim_explore("w", 4, 100)  # measured signatures too
+
+
+def test_choose_variant_sticky_sequence():
+    """The exact cold-path sequence the flush loop sees: explore a, then
+    b, then fall back to candidates[0] instead of re-running a cold
+    candidate; once data lands, measured EMAs decide."""
+    from skyline_tpu.telemetry.profiler import KernelProfiler
+
+    prof = KernelProfiler(backend="cpu")
+    cands = ("a", "b")
+    assert choose_variant(prof, cands, 4, 100) == "a"  # claims a
+    assert choose_variant(prof, cands, 4, 100) == "b"  # a in flight: b
+    assert choose_variant(prof, cands, 4, 100) == "a"  # all claimed
+    with prof.record("a", 4, 100):
+        pass
+    assert choose_variant(prof, cands, 4, 100) == "a"  # only measured one
+    with prof.record("b", 4, 100):
+        pass
+    best = min(
+        ("a", "b"), key=lambda v: prof.ema_ms(v, 4, 100)
+    )
+    assert choose_variant(prof, cands, 4, 100) == best
+
+
+def test_choose_variant_no_profiler():
+    assert choose_variant(None, ("a", "b"), 4, 100) == "a"
+
+
+# ---------------------------------------------------------------------------
+# knob plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_block_knob(monkeypatch):
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE_BLOCK", "100")
+    assert dc.device_cascade_block() == 128  # rounded up to a power of two
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE_BLOCK", "3")
+    assert dc.device_cascade_block() == 8  # floor
+    monkeypatch.delenv("SKYLINE_DEVICE_CASCADE_BLOCK", raising=False)
+    assert dc.device_cascade_block() == 2048
+
+
+def test_block_knob_identity(monkeypatch, rng):
+    """Identity must hold at every block size, including blocks larger
+    than the padded input."""
+    x = gen_points(rng, 200, 5, "anti")
+    want = _referee(x)
+    for blk in ("8", "64", "8192"):
+        monkeypatch.setenv("SKYLINE_DEVICE_CASCADE_BLOCK", blk)
+        got = np.asarray(device_cascade_mask(jnp.asarray(x)))
+        assert np.array_equal(got, want), blk
