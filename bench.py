@@ -920,6 +920,18 @@ def child_main(backend: str) -> None:
             serve = {"error": f"{type(e).__name__}: {e}"}
     else:
         serve = {"skipped": True}
+    # serve-load leg: multi-tenant body-store A/B under zipf-skewed load
+    # (BENCH_LOAD=0 to skip; identity asserted before timing —
+    # benchmarks/loadgen.py, RUNBOOK §2u)
+    if env_bool("BENCH_LOAD", True):
+        try:
+            from benchmarks.loadgen import run_load
+
+            serve_load = run_load()
+        except Exception as e:  # pragma: no cover - diagnostic path
+            serve_load = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        serve_load = {"skipped": True}
     # replica-plane leg: WAL tail-to-serve lag (BENCH_REPLICA=0 to skip)
     if env_bool("BENCH_REPLICA", True):
         try:
@@ -1036,6 +1048,7 @@ def child_main(backend: str) -> None:
                 "flush_policy": cfg.flush_policy,
                 "rank_cascade": rank_cascade_stamp(),
                 "serve": serve,
+                "serve_load": serve_load,
                 "replica": replica,
                 "cluster": cluster,
                 "ops": ops,

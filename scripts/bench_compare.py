@@ -69,6 +69,15 @@ METRICS = (
     ("p50_window_latency_ms", ("p50_window_latency_ms",), False, False),
     ("serve.read_p50_ms", ("serve", "read_p50_ms"), False, False),
     ("serve.read_p99_ms", ("serve", "read_p99_ms"), False, False),
+    # serve-load leg (ISSUE 19, benchmarks/loadgen.py via bench.py): the
+    # multi-tenant harness's read p99 and shed fraction on the zero-copy
+    # body-store arm — p99 creeping up means reads are paying Python
+    # serialization again; shed creeping up means admission is dropping
+    # traffic the body path used to absorb. Absent (pre-§2u artifacts or
+    # BENCH_LOAD=0) skips, never fails
+    ("serve_load.read_p99_ms", ("serve_load", "read_p99_ms"), False, False),
+    ("serve_load.shed_fraction", ("serve_load", "shed_fraction"),
+     False, False),
     # merge-cache leg (bench.py merge_cache_leg): a hit-rate drop means the
     # epoch-keyed reuse went dead — absent/zero (older artifacts, leg
     # errored) skips, never fails

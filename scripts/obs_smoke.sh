@@ -648,6 +648,124 @@ finally:
     shutil.rmtree(wal_dir, ignore_errors=True)
 EOF
 
+# zero-copy body store (ISSUE 19, RUNBOOK §2u): the publish-time body
+# store must be LIVE on the serve path — bodystore hit/torn/retry and
+# read-cache counters as Prometheus families on /metrics — and a
+# WAL-tailing replica must serve the primary's EXACT bytes (sha256)
+# out of the shared store, plus the sentinel must watch the load
+# harness's read p99 and shed fraction
+JAX_PLATFORMS=cpu python - <<'EOF'
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import urllib.request
+
+import numpy as np
+
+from skyline_tpu.resilience.wal import WalWriter
+from skyline_tpu.serve import (
+    SkylineServer,
+    SnapshotStore,
+    delta_wal_record,
+)
+from skyline_tpu.serve.bodystore import BodyStore
+from skyline_tpu.serve.replica import SkylineReplica
+from skyline_tpu.telemetry.sentinel import DEFAULT_RULES
+
+for label in ("serve_load.read_p99_ms", "serve_load.shed_fraction"):
+    assert any(r["label"] == label for r in DEFAULT_RULES), \
+        f"sentinel does not watch {label}"
+
+wal_dir = tempfile.mkdtemp(prefix="skyline-bodystore-obs-")
+rng = np.random.default_rng(47)
+writer = WalWriter(wal_dir, fsync="off")
+
+
+def shadow(prev, snap):
+    writer.append(delta_wal_record(prev, snap))
+    writer.flush(force=True)
+
+
+store = SnapshotStore()
+store.on_publish(shadow)
+body = BodyStore(os.path.join(wal_dir, "bodystore.dat")).attach(store)
+primary = SkylineServer(store, port=0, read_cache=0, bodystore=body)
+rep = SkylineReplica(wal_dir, replica_id="obs-body-rep",
+                     poll_interval_s=0.005, start=True)
+try:
+    assert rep.bodystore is not None, \
+        "replica did not open the shared body store"
+    store.publish(rng.random((96, 4)).astype(np.float32),
+                  watermark_id=7, partial=True)
+    assert rep.wait_for_version(1, timeout_s=10.0)
+
+    # every wire shape must hash identically primary vs replica: the
+    # replica is serving the primary's preserialized bytes, not its own.
+    # JSON bodies splice a per-request volatile tail (age/staleness and
+    # the replica's restored marker) after the store-served prefix, so
+    # the identity claim — and the hash — covers the prefix; csv has no
+    # tail and hashes whole
+    paths = ("/skyline", "/skyline?points=0", "/skyline?explain=1",
+             "/skyline?format=csv")
+    for path in paths:
+        digests = []
+        for port in (primary.port, rep.port):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                raw = r.read()
+            if b"csv" not in path.encode():
+                raw = raw.split(b', "age_ms":')[0]
+            digests.append(hashlib.sha256(raw).hexdigest())
+        assert digests[0] == digests[1], \
+            f"replica served different bytes for {path}"
+
+    stats = rep.bodystore.stats()
+    assert stats["hits"] >= 1, stats  # replica reads actually hit the ring
+    assert body.stats()["bodies_published"] >= 1, body.stats()
+
+    # bodystore + read-cache counter families must be live on /metrics
+    # (the primary runs read_cache=0 so every read exercises the store:
+    # misses family on the primary, hits family on the LRU'd replica
+    # after a repeated read)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{primary.port}/metrics", timeout=5
+    ) as r:
+        prom = r.read().decode()
+    for fam in ("skyline_serve_bodystore_hits_total",
+                "skyline_serve_bodystore_misses_total",
+                "skyline_serve_bodystore_torn_reads_total",
+                "skyline_serve_bodystore_retries_total",
+                "skyline_serve_read_cache_misses_total"):
+        assert fam in prom, f"{fam} missing from exposition"
+    urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/skyline?format=csv", timeout=5
+    ).read()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{rep.port}/metrics", timeout=5
+    ) as r:
+        rprom = r.read().decode()
+    assert "skyline_serve_read_cache_hits_total" in rprom, \
+        "read_cache_hits family missing from replica exposition"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{primary.port}/stats", timeout=5
+    ) as r:
+        sdoc = json.load(r)
+    assert sdoc["bodystore"]["bodies_published"] >= 1, sdoc["bodystore"]
+    print(f"[obs-smoke] bodystore ok: {len(paths)} wire shapes "
+          f"sha256-identical primary vs replica out of the shared store "
+          f"({stats['hits']} replica ring hit(s), 0 torn), counter "
+          f"families live on /metrics, sentinel watches serve_load")
+finally:
+    rep.close()
+    primary.close()
+    body.close()
+    writer.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+EOF
+
 # regression gate: newest two artifacts must currently pass at default
 # threshold, and an artificially regressed NEW must fail with rc 1
 python scripts/bench_compare.py

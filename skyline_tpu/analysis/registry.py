@@ -338,6 +338,34 @@ KNOBS: tuple[Knob, ...] = (
     _k("SKYLINE_REPLICA_POLL_MS", "float", 25.0,
        "replica WAL tail poll interval when no new frames are available",
        "serve", runbook="§2q"),
+    _k("SKYLINE_BODYSTORE", "bool", True,
+       "zero-copy body store: serialize wire bodies once at publish time "
+       "and serve them via fence-checked buffer handoffs (primary retained "
+       "bytes; replicas map the primary's bodystore.dat)", "serve",
+       runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_BYTES", "int", 8 << 20,
+       "body-store data ring capacity in bytes; bodies larger than this "
+       "skip the mmap (in-process retained bytes still serve them)",
+       "serve", runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_SLOTS", "int", 512,
+       "body-store directory slots ((version, format) keys live at "
+       "(version*5+fmt) mod slots)", "serve", runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_RETRIES", "int", 4,
+       "bounded seqlock retries per body-store read before declaring a "
+       "miss and falling back to Python serialization", "serve",
+       runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_KEEP", "int", 4,
+       "snapshot versions whose wire bodies the primary retains in-process "
+       "(zero-copy dict hits; older versions fall through to the mmap "
+       "ring)", "serve", runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_NATIVE", "bool", True,
+       "use the native sky_format_rows row serializer for body encoding "
+       "(0 forces the byte-identical pure-Python encoders)", "serve",
+       runbook="§2u"),
+    _k("SKYLINE_BODYSTORE_VERIFY", "bool", False,
+       "verify EVERY native-encoded body against the Python encoder "
+       "(default verifies only the first per process); mismatch disables "
+       "the native path", "serve", runbook="§2u"),
     _k("SKYLINE_TRACE_OUT", "str", "",
        "write the span ring as Chrome trace-event JSON on shutdown",
        "job flag", runbook="§2b", job_field="trace_out"),
@@ -598,6 +626,28 @@ KNOBS: tuple[Knob, ...] = (
        "replica-leg publish transitions tailed", "bench"),
     _k("BENCH_REPLICA_ROWS", "int", 2048,
        "replica-leg rows per published snapshot", "bench"),
+    _k("BENCH_LOAD", "bool", True,
+       "run the serve_load leg (benchmarks/loadgen.py multi-tenant A/B "
+       "harness)", "bench", runbook="§2u"),
+    _k("BENCH_LOAD_TENANTS", "int", 10_000,
+       "synthetic tenants in the load harness (zipf-skewed)", "bench",
+       runbook="§2u"),
+    _k("BENCH_LOAD_SECONDS", "float", 3.0,
+       "measured wall seconds per load-harness arm", "bench",
+       runbook="§2u"),
+    _k("BENCH_LOAD_WORKERS", "int", 8,
+       "concurrent client worker threads in the load harness", "bench",
+       runbook="§2u"),
+    _k("BENCH_LOAD_ZIPF", "float", 1.1,
+       "zipf exponent for tenant skew (higher = hotter head tenants)",
+       "bench", runbook="§2u"),
+    _k("BENCH_LOAD_BURST", "float", 0.05,
+       "burst-storm fraction: slice of request slots fired as "
+       "simultaneous storms against the head tenants", "bench",
+       runbook="§2u"),
+    _k("BENCH_LOAD_SSE", "int", 4,
+       "long-lived SSE subscriber connections held open during the load "
+       "run", "bench", runbook="§2u"),
     _k("BENCH_CLUSTER", "bool", True,
        "run the cluster-plane bench leg (host-prune probe + promotion "
        "drill)", "bench", runbook="§2r"),

@@ -142,6 +142,7 @@ class SkylineWorker:
         self._deposed = False
         self._snap_store = None
         self._serve_ring = None
+        self._bodystore = None
         self._data_pos = 0  # consumed data-topic records (replay currency)
         self._query_pos = 0  # consumed query-topic records
         self._dirty = False  # work since the last checkpoint
@@ -257,6 +258,22 @@ class SkylineWorker:
             self._serve_bridge = QueryBridge()
             self._snap_store = store
             self._serve_ring = ring
+            # zero-copy body store (RUNBOOK §2u): wire bodies serialize
+            # once per publish, off the read path. With resilience the
+            # store file lands beside the WAL so --replicas / --replica-of
+            # processes map the primary's exact bytes; without a WAL dir
+            # it stays in-process (publish-time serialization still wins).
+            from skyline_tpu.analysis.registry import env_bool
+
+            if env_bool("SKYLINE_BODYSTORE", True):
+                from skyline_tpu.serve.bodystore import BodyStore
+
+                wal_dir = getattr(self, "_wal_dir", None)
+                self._bodystore = BodyStore(
+                    os.path.join(wal_dir, "bodystore.dat")
+                    if wal_dir is not None
+                    else None
+                ).attach(store)
             try:
                 self.serve_server = SkylineServer(
                     store,
@@ -268,6 +285,7 @@ class SkylineWorker:
                     host=scfg.host,
                     telemetry=self.telemetry,
                     read_cache=scfg.read_cache_entries,
+                    bodystore=self._bodystore,
                 )
             except OSError as e:
                 # like /stats: the serving plane is optional — a port
@@ -276,6 +294,9 @@ class SkylineWorker:
                 self._serve_bridge = None
                 self._snap_store = None
                 self._serve_ring = None
+                if self._bodystore is not None:
+                    self._bodystore.close()
+                    self._bodystore = None
                 print(
                     f"skyline worker: serve port {serve_port} unavailable "
                     f"({e}); continuing without the serving plane",
@@ -522,6 +543,8 @@ class SkylineWorker:
             self.stats_server.close()
         if self.serve_server is not None:
             self.serve_server.close()
+        if self._bodystore is not None:
+            self._bodystore.close()
         for replica in getattr(self, "replicas", []):
             replica.close()
         if self._wal is not None:

@@ -87,6 +87,16 @@ def get_lib():
                 ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int64),
             ]
+        if hasattr(lib, "sky_format_rows"):
+            lib.sky_format_rows.restype = ctypes.c_int64
+            lib.sky_format_rows.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_char_p,
+                ctypes.c_int64,
+            ]
         if hasattr(lib, "sky_parse_recordbatches"):
             lib.sky_parse_recordbatches.restype = ctypes.c_int64
             lib.sky_parse_recordbatches.argtypes = [
@@ -161,6 +171,39 @@ def format_tuples_native(ids: np.ndarray, values: np.ndarray):
     if w < 0:
         return None
     return out[:w].tobytes(), offsets
+
+
+ROWS_JSON = 0
+ROWS_CSV = 1
+
+
+def format_rows_native(points: np.ndarray, mode: int):
+    """Serialize a (k, d) float32 row block into one wire body — the serve
+    plane's publish-time body serializer (serve/bodystore.py). ``mode``
+    ``ROWS_JSON`` yields the JSON points array byte-identical to
+    ``json.dumps(points.tolist())``; ``ROWS_CSV`` yields the ``format=csv``
+    block byte-identical to newline-joined ``wire.format_tuple_line(i, row)``.
+    Returns bytes, or None if the library or symbol is unavailable (callers
+    fall back to the Python encoders)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "sky_format_rows"):
+        return None
+    pts = np.ascontiguousarray(points, dtype=np.float32)
+    k, d = pts.shape
+    # 27 bytes of float repr + separators/brackets per field, plus row ids
+    cap = k * (d + 1) * 32 + 64
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.sky_format_rows(
+        pts.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        k,
+        d,
+        int(mode),
+        buf,
+        cap,
+    )
+    if n < 0:
+        return None
+    return buf.raw[:n]
 
 
 # per-record frame overhead bound used to size native encode outputs and

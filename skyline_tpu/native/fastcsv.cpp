@@ -417,3 +417,193 @@ extern "C" int64_t sky_format_tuples(const int64_t* ids,
     offsets[n] = w - out;
     return w - out;
 }
+
+// ---------------------------------------------------------------------------
+// Wire-body row serializer (serve/bodystore.py): the JSON points array and
+// the format=csv line block the serving plane preserializes at publish time.
+// Byte parity contract: mode 0 must equal json.dumps(points.tolist()) and
+// mode 1 must equal "\n".join(wire.format_tuple_line(i, row)) — both reduce
+// to CPython's float.__repr__, the shortest decimal string that round-trips
+// to the double (each float32 widened to double first, exactly like
+// tolist()/float()). glibc's printf is correctly rounded at any precision,
+// so the minimal round-tripping "%.*e" precision (found by binary search —
+// round-tripping is monotone in digit count) yields the same digit string
+// as CPython's dtoa; only the presentation (fixed vs scientific, ".0"
+// suffix, two-digit exponents) differs, and that is reformatted below under
+// CPython's rules. A bits-keyed memo table makes steady-state publishes
+// cheap: skyline rows mostly survive each merge, so the same float32 values
+// recur version after version.
+
+#include <cstdio>
+#include <mutex>
+
+namespace {
+
+std::mutex g_repr_mutex;  // ctypes drops the GIL; the memo table needs one
+
+struct ReprEnt {
+    uint32_t bits;
+    uint8_t len;  // 0 = empty slot (a real repr is never empty)
+    char s[27];   // max: '-' + 17 digits + punctuation/exponent <= 25
+};
+ReprEnt g_repr_cache[1 << 16];
+
+bool roundtrips(double v, int prec, char* buf) {
+    snprintf(buf, 40, "%.*e", prec - 1, v);
+    return strtod(buf, nullptr) == v;
+}
+
+// Positive finite v -> CPython repr; returns bytes written (no NUL).
+int repr_positive(double v, char* out) {
+    char buf[48];
+    int lo = 1, hi = 17;
+    while (lo < hi) {  // minimal digit count whose conversion round-trips
+        const int mid = (lo + hi) / 2;
+        if (roundtrips(v, mid, buf)) hi = mid; else lo = mid + 1;
+    }
+    snprintf(buf, sizeof buf, "%.*e", lo - 1, v);
+    char digits[20];
+    int k = 0;
+    const char* p = buf;
+    digits[k++] = *p++;
+    if (*p == '.') {
+        ++p;
+        while (*p != 'e') digits[k++] = *p++;
+    }
+    while (*p != 'e') ++p;
+    const int e10 = atoi(p + 1);
+    // CPython float_repr: fixed notation for -4 <= e10 < 16, else
+    // scientific with a sign and a >=2-digit exponent
+    int n = 0;
+    if (-4 <= e10 && e10 < 16) {
+        if (e10 >= k - 1) {
+            for (int i = 0; i < k; ++i) out[n++] = digits[i];
+            for (int i = 0; i < e10 - (k - 1); ++i) out[n++] = '0';
+            out[n++] = '.';
+            out[n++] = '0';
+        } else if (e10 >= 0) {
+            for (int i = 0; i <= e10; ++i) out[n++] = digits[i];
+            out[n++] = '.';
+            for (int i = e10 + 1; i < k; ++i) out[n++] = digits[i];
+        } else {
+            out[n++] = '0';
+            out[n++] = '.';
+            for (int i = 0; i < -e10 - 1; ++i) out[n++] = '0';
+            for (int i = 0; i < k; ++i) out[n++] = digits[i];
+        }
+    } else {
+        out[n++] = digits[0];
+        if (k > 1) {
+            out[n++] = '.';
+            for (int i = 1; i < k; ++i) out[n++] = digits[i];
+        }
+        out[n++] = 'e';
+        int ae = e10;
+        if (e10 >= 0) {
+            out[n++] = '+';
+        } else {
+            out[n++] = '-';
+            ae = -e10;
+        }
+        if (ae >= 100) {
+            out[n++] = static_cast<char>('0' + ae / 100);
+            ae %= 100;
+        }
+        out[n++] = static_cast<char>('0' + ae / 10);
+        out[n++] = static_cast<char>('0' + ae % 10);
+    }
+    return n;
+}
+
+// One float32 -> its wire text. JSON spells non-finites the json.dumps way
+// (NaN/Infinity); CSV spells them the str(float()) way (nan/inf).
+int fmt_value(float f, char* w, bool json) {
+    const double v = static_cast<double>(f);
+    if (std::isnan(v)) {
+        const char* s = json ? "NaN" : "nan";
+        const int n = json ? 3 : 3;
+        memcpy(w, s, n);
+        return n;
+    }
+    if (std::isinf(v)) {
+        const char* s = json ? (std::signbit(v) ? "-Infinity" : "Infinity")
+                             : (std::signbit(v) ? "-inf" : "inf");
+        const int n = static_cast<int>(strlen(s));
+        memcpy(w, s, n);
+        return n;
+    }
+    uint32_t bits;
+    memcpy(&bits, &f, 4);
+    ReprEnt& e = g_repr_cache[(bits * 2654435761u) >> 16];
+    if (e.len && e.bits == bits) {
+        memcpy(w, e.s, e.len);
+        return e.len;
+    }
+    char* p = w;
+    if (std::signbit(v)) *p++ = '-';
+    if (f == 0.0f) {
+        p[0] = '0';
+        p[1] = '.';
+        p[2] = '0';
+        p += 3;
+    } else {
+        p += repr_positive(std::signbit(v) ? -v : v, p);
+    }
+    const int n = static_cast<int>(p - w);
+    e.bits = bits;
+    e.len = static_cast<uint8_t>(n);
+    memcpy(e.s, w, n);
+    return n;
+}
+
+}  // namespace
+
+// Serialize a (k, d) float32 row block into one wire body. mode 0: the JSON
+// points array `[[a, b], [c, d]]` with json.dumps' default ", " separators;
+// mode 1: format=csv lines `i,v1,...,vd` joined by '\n' (ids are the row
+// enumeration, matching the serve handler). Returns bytes written, or -1 if
+// out_cap would be exceeded (callers size at ~30 bytes/field and fall back
+// to Python formatting on -1).
+extern "C" int64_t sky_format_rows(const float* vals, int64_t k, int32_t d,
+                                   int32_t mode, char* out, int64_t out_cap) {
+    std::lock_guard<std::mutex> guard(g_repr_mutex);
+    char* w = out;
+    const char* end = out + out_cap;
+    if (mode == 0) {
+        if (end - w < 2) return -1;
+        *w++ = '[';
+        for (int64_t i = 0; i < k; ++i) {
+            if (end - w < 4) return -1;
+            if (i) {
+                *w++ = ',';
+                *w++ = ' ';
+            }
+            *w++ = '[';
+            const float* row = vals + i * d;
+            for (int32_t j = 0; j < d; ++j) {
+                if (end - w < 32) return -1;
+                if (j) {
+                    *w++ = ',';
+                    *w++ = ' ';
+                }
+                w += fmt_value(row[j], w, true);
+            }
+            if (end - w < 2) return -1;
+            *w++ = ']';
+        }
+        *w++ = ']';
+    } else {
+        for (int64_t i = 0; i < k; ++i) {
+            if (end - w < 24) return -1;
+            if (i) *w++ = '\n';
+            w = write_i64(w, i);
+            const float* row = vals + i * d;
+            for (int32_t j = 0; j < d; ++j) {
+                if (end - w < 32) return -1;
+                *w++ = ',';
+                w += fmt_value(row[j], w, false);
+            }
+        }
+    }
+    return w - out;
+}

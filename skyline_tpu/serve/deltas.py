@@ -176,13 +176,42 @@ def snapshot_wal_record(snap) -> dict:
 class Delta:
     """One published transition: what changed going from_version -> to_version."""
 
-    __slots__ = ("from_version", "to_version", "entered", "left")
+    __slots__ = (
+        "from_version",
+        "to_version",
+        "entered",
+        "left",
+        "_entered_json",
+        "_left_json",
+    )
 
     def __init__(self, from_version, to_version, entered, left):
         self.from_version = from_version
         self.to_version = to_version
         self.entered = entered
         self.left = left
+        self._entered_json = None
+        self._left_json = None
+
+    # preserialized wire fragments, byte-identical to
+    # ``json.dumps(arr.tolist()).encode()`` — memoized so the /deltas
+    # handler, the SSE fanout, and replica re-serves of one transition pay
+    # the row encoding once (through the body store's native row encoder
+    # when the .so is present)
+
+    def entered_json(self) -> bytes:
+        if self._entered_json is None:
+            from skyline_tpu.serve.bodystore import points_json
+
+            self._entered_json = points_json(self.entered)
+        return self._entered_json
+
+    def left_json(self) -> bytes:
+        if self._left_json is None:
+            from skyline_tpu.serve.bodystore import points_json
+
+            self._left_json = points_json(self.left)
+        return self._left_json
 
 
 class DeltaRing:

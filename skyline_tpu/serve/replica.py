@@ -106,6 +106,22 @@ class SkylineReplica:
         self._backoff_base_s = backoff_base_s
         self.store = SnapshotStore(history=scfg.history)
         self.ring = DeltaRing(self.store, capacity=scfg.delta_ring)
+        # zero-copy read path (RUNBOOK §2u): map the PRIMARY's body store
+        # (it lives beside the WAL, same shared filesystem) read-only and
+        # serve the primary's exact bytes — the replica stops
+        # re-serializing what the WAL already delivered byte-verified.
+        # Staleness honesty is unaffected: version selection and the fence
+        # still come from the replica's own folded store; the mapping only
+        # replaces how a chosen version's bytes are produced.
+        from skyline_tpu.analysis.registry import env_bool
+
+        self.bodystore = None
+        if env_bool("SKYLINE_BODYSTORE", True):
+            from skyline_tpu.serve.bodystore import BodyStoreReader
+
+            self.bodystore = BodyStoreReader(
+                os.path.join(wal_dir, "bodystore.dat")
+            )
         self.server = SkylineServer(
             self.store,
             deltas=self.ring,
@@ -118,6 +134,7 @@ class SkylineReplica:
             read_cache=scfg.read_cache_entries,
             max_stale_ms=self.max_stale_ms,
             role="replica",
+            bodystore=self.bodystore,
         )
         self.port = self.server.port
         # cluster role (RUNBOOK §2r): "replica" until a ClusterSupervisor
@@ -531,6 +548,8 @@ class SkylineReplica:
         if repl is not None and self in repl:
             repl.remove(self)
         self.server.close()
+        if self.bodystore is not None:
+            self.bodystore.close()
 
 
 def _empty(d: int):

@@ -222,6 +222,7 @@ class SkylineServer:
         read_cache: int = 64,
         max_stale_ms: float | None = None,
         role: str = "primary",
+        bodystore=None,
     ):
         """``max_stale_ms``: the staleness fence — any ``/skyline`` read
         whose snapshot is older than this (event-time watermark when
@@ -229,9 +230,13 @@ class SkylineServer:
         Retry-After, regardless of ``allow_stale``. The replica plane's
         honesty contract; None (primary default) disables. ``role`` rides
         ``/healthz`` and fence rejections so probes can tell a replica
-        from the primary."""
+        from the primary. ``bodystore``: a ``serve/bodystore.py``
+        BodyStore (primary, publish-time serialized bodies) or
+        BodyStoreReader (replica, the PRIMARY's exact bytes via the shared
+        mmap) consulted between the LRU and the serialize-on-miss path."""
         self.store = store
         self.deltas = deltas
+        self.bodystore = bodystore
         self.admission = admission if admission is not None else AdmissionController()
         self.stats_cb = stats_cb
         self.bridge = bridge
@@ -334,17 +339,21 @@ class SkylineServer:
             return
         tail = self.deltas.latest() if self.deltas is not None else None
         if tail is not None and tail.to_version == snap.version:
-            event = (
-                "delta",
-                {
-                    "from_version": tail.from_version,
-                    "to_version": tail.to_version,
-                    "watermark_id": snap.watermark_id,
-                    "entered": tail.entered.tolist(),
-                    "left": tail.left.tolist(),
-                    "meta": snap.meta,
-                },
+            # preserialize the payload ONCE here (publish time) via the
+            # Delta's memoized row fragments — every subscriber then gets
+            # the same bytes with no per-connection serialization. The
+            # splice is byte-identical to json.dumps of the equivalent
+            # doc (test-asserted).
+            payload = (
+                b'{"from_version": ' + str(tail.from_version).encode()
+                + b', "to_version": ' + str(tail.to_version).encode()
+                + b', "watermark_id": ' + str(snap.watermark_id).encode()
+                + b', "entered": ' + tail.entered_json()
+                + b', "left": ' + tail.left_json()
+                + b', "meta": ' + json.dumps(snap.meta).encode()
+                + b"}"
             )
+            event = ("delta", payload)
         else:  # no ring: announce the version; subscribers re-read
             event = ("resync", {"head_version": snap.version})
         try:
@@ -414,15 +423,16 @@ class SkylineServer:
                     )
                 else:
                     entered, left, hv = res
+                    from skyline_tpu.serve.bodystore import points_json
+
                     await self._sse_write(
                         writer,
                         "delta",
-                        {
-                            "from_version": since,
-                            "to_version": hv,
-                            "entered": entered.tolist(),
-                            "left": left.tolist(),
-                        },
+                        b'{"from_version": ' + str(since).encode()
+                        + b', "to_version": ' + str(hv).encode()
+                        + b', "entered": ' + points_json(entered)
+                        + b', "left": ' + points_json(left)
+                        + b"}",
                     )
             while True:
                 try:
@@ -439,10 +449,11 @@ class SkylineServer:
         finally:
             self._sse_queues.discard(q)
 
-    async def _sse_write(self, writer, kind: str, doc: dict) -> None:
-        writer.write(
-            f"event: {kind}\ndata: {json.dumps(doc)}\n\n".encode()
-        )
+    async def _sse_write(self, writer, kind: str, doc) -> None:
+        """``doc``: a dict (serialized here) or preserialized payload bytes
+        (the publish-time fast path — one encode shared by every stream)."""
+        data = doc if isinstance(doc, bytes) else json.dumps(doc).encode()
+        writer.write(b"event: " + kind.encode() + b"\ndata: " + data + b"\n\n")
         await writer.drain()
 
     # -- request plumbing --------------------------------------------------
@@ -560,6 +571,8 @@ class SkylineServer:
         if self.max_stale_ms is not None:
             out["serve"]["max_stale_ms"] = self.max_stale_ms
         out["snapshot_store"] = self.store.stats()
+        if self.bodystore is not None:
+            out["bodystore"] = self.bodystore.stats()
         if self.deltas is not None:
             out["delta_ring"] = self.deltas.stats()
         if self.bridge is not None:
@@ -585,6 +598,16 @@ class SkylineServer:
         while len(self._read_cache) > self._read_cache_cap:
             self._read_cache.popitem(last=False)
 
+    def _body_get(self, version: int, fmt: int) -> bytes | None:
+        """The body store tier between the LRU and serialize-on-miss: the
+        publisher's preserialized bytes (primary: retained objects;
+        replica: the primary's mmap frames behind the seqlock+fence
+        check). Hits/misses/torn reads are counted on the store itself and
+        surfaced by /metrics as ``skyline_serve_bodystore_*``."""
+        if self.bodystore is None:
+            return None
+        return self.bodystore.get(version, fmt)
+
     # -- endpoints ---------------------------------------------------------
 
     async def _metrics(self, writer):
@@ -601,6 +624,15 @@ class SkylineServer:
             f"serve_{k}": v
             for k, v in self.admission.counters.snapshot().items()
         }
+        if self.bodystore is not None:
+            # zero-copy body-store families: hits/misses/torn_reads/retries
+            # plus the publish-side serializer tallies (RUNBOOK §2u)
+            counters.update(
+                {
+                    f"serve_bodystore_{k}": v
+                    for k, v in self.bodystore.stats().items()
+                }
+            )
         # per-tenant admission series: one labeled family per outcome, so
         # dashboards see exactly who is being shed
         tenants = self.admission.tenant_stats()
@@ -696,12 +728,11 @@ class SkylineServer:
         if params.get("format") == "csv":
             body = self._cache_get((snap.version, "csv"))
             if body is None:
-                from skyline_tpu.bridge.wire import format_tuple_line
+                from skyline_tpu.serve import bodystore as bs
 
-                body = "\n".join(
-                    format_tuple_line(i, row)
-                    for i, row in enumerate(snap.points)
-                ).encode()
+                body = self._body_get(snap.version, bs.FMT_CSV)
+                if body is None:
+                    body = bs.csv_body(snap)
                 self._cache_put((snap.version, "csv"), body)
             await self._reply_raw(
                 writer,
@@ -730,9 +761,13 @@ class SkylineServer:
             (snap.version, "json", include_points, want_explain)
         )
         if prefix is None:
-            prefix = json.dumps(snap.to_doc(include_points=include_points))[
-                :-1
-            ].encode()
+            from skyline_tpu.serve import bodystore as bs
+
+            prefix = self._body_get(
+                snap.version, bs.fmt_code("json", include_points, want_explain)
+            )
+            if prefix is None:
+                prefix = bs.json_prefix(snap, include_points=include_points)
             self._cache_put(
                 (snap.version, "json", include_points, want_explain), prefix
             )
@@ -924,23 +959,25 @@ class SkylineServer:
         entered, left, head = res
         self.admission.counters.inc("deltas_served")
         rs = self.store.read()
-        await self._reply(
-            writer,
-            200,
-            {
-                "from_version": since,
-                "to_version": head,
-                "resync": False,
-                "count_entered": int(entered.shape[0]),
-                "count_left": int(left.shape[0]),
-                "entered": entered.tolist(),
-                "left": left.tolist(),
-                # the freshness watermark rides every read surface
-                "staleness_ms": (
-                    round(rs.staleness_ms, 1) if rs is not None else None
-                ),
-            },
+        # spliced assembly (byte-identical to json.dumps of the doc —
+        # test-asserted): the row arrays go through the body store's
+        # native-backed encoder instead of tolist() + json.dumps
+        from skyline_tpu.serve.bodystore import points_json
+
+        sms = round(rs.staleness_ms, 1) if rs is not None else None
+        body = (
+            b'{"from_version": ' + str(since).encode()
+            + b', "to_version": ' + str(head).encode()
+            + b', "resync": false'
+            + b', "count_entered": ' + str(int(entered.shape[0])).encode()
+            + b', "count_left": ' + str(int(left.shape[0])).encode()
+            + b', "entered": ' + points_json(entered)
+            + b', "left": ' + points_json(left)
+            # the freshness watermark rides every read surface
+            + b', "staleness_ms": ' + json.dumps(sms).encode()
+            + b"}"
         )
+        await self._reply_raw(writer, 200, body, "application/json")
 
     async def _query(self, writer):
         if self.bridge is None:

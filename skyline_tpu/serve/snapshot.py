@@ -65,7 +65,13 @@ class Snapshot:
     def size(self) -> int:
         return int(self.points.shape[0])
 
-    def to_doc(self, include_points: bool = True) -> dict:
+    def doc_head(self) -> dict:
+        """The wire doc minus ``points``. CONTRACT: ``points`` is always the
+        doc's FINAL key, so ``json.dumps(doc_head())[:-1]`` + a preserialized
+        ``, "points": [...]`` fragment is byte-identical to
+        ``json.dumps(to_doc(include_points=True))[:-1]`` — the splice the
+        body store (serve/bodystore.py) builds cached prefixes from. Meta
+        keys must therefore never be named ``points``."""
         doc = {
             "version": self.version,
             "watermark_id": self.watermark_id,
@@ -76,6 +82,10 @@ class Snapshot:
         if self.event_wm_ms is not None:
             doc["event_wm_ms"] = self.event_wm_ms
         doc.update(self.meta)
+        return doc
+
+    def to_doc(self, include_points: bool = True) -> dict:
+        doc = self.doc_head()
         if include_points:
             doc["points"] = self.points.tolist()
         return doc

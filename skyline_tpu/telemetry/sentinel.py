@@ -48,6 +48,19 @@ DEFAULT_RULES = (
      "higher_is_better": False},
     {"label": "serve.read_p99_ms", "path": ["serve", "read_p99_ms"],
      "higher_is_better": False},
+    # serve-load plane (ISSUE 19): the load harness's read p99 regressing
+    # means the zero-copy body path is decaying back toward per-request
+    # serialization; wall-clock under thread contention on the CPU
+    # fallback is noisy, so only a blowup trips
+    {"label": "serve_load.read_p99_ms",
+     "path": ["serve_load", "read_p99_ms"], "higher_is_better": False,
+     "threshold": 2.0},
+    # shed fraction is structural (set by the harness's per-tenant token
+    # buckets), so a creep-up means admission is shedding traffic the
+    # body path used to absorb
+    {"label": "serve_load.shed_fraction",
+     "path": ["serve_load", "shed_fraction"], "higher_is_better": False,
+     "threshold": 2.0},
     {"label": "merge_cache.hit_rate", "path": ["merge_cache", "hit_rate"],
      "higher_is_better": True},
     {"label": "merge_tree.pruned_fraction",

@@ -1,0 +1,439 @@
+"""Body-store plane (RUNBOOK §2u): byte identity, seqlock/fence torn-read
+discipline, native-vs-Python encoder equality, and the serve wiring.
+
+The load-bearing property everywhere: the store serves EXACT bytes or
+nothing — every miss/torn path falls back to direct serialization, so a
+body can be slow but never wrong.
+"""
+
+import json
+import socket
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from skyline_tpu.bridge.wire import format_tuple_line
+from skyline_tpu.serve import DeltaRing, SkylineServer, SnapshotStore
+from skyline_tpu.serve import bodystore as bs
+from skyline_tpu.serve.bodystore import (
+    FMT_CSV,
+    FMT_JSON_NOPOINTS,
+    FMT_JSON_NOPOINTS_EXPLAIN,
+    FMT_JSON_POINTS,
+    FMT_JSON_POINTS_EXPLAIN,
+    BodyStore,
+    BodyStoreReader,
+    csv_body,
+    fmt_code,
+    json_prefix,
+    points_json,
+)
+
+
+def _pts(rng, k=20, d=4):
+    return (rng.uniform(0, 10_000, size=(k, d))).astype(np.float32)
+
+
+def _json_ref(snap, include_points):
+    return json.dumps(snap.to_doc(include_points=include_points))[:-1].encode()
+
+
+def _csv_ref(snap):
+    return "\n".join(
+        format_tuple_line(i, row) for i, row in enumerate(snap.points)
+    ).encode()
+
+
+# --------------------------------------------------------------------------
+# encoders: byte identity, native parity
+# --------------------------------------------------------------------------
+
+
+def test_fmt_code_covers_the_read_key_grid():
+    assert fmt_code("csv") == FMT_CSV
+    assert fmt_code("json", True, False) == FMT_JSON_POINTS
+    assert fmt_code("json", False, False) == FMT_JSON_NOPOINTS
+    assert fmt_code("json", True, True) == FMT_JSON_POINTS_EXPLAIN
+    assert fmt_code("json", False, True) == FMT_JSON_NOPOINTS_EXPLAIN
+    assert len(
+        {fmt_code(f, p, e) for f, p, e in [
+            ("csv", True, False), ("json", True, False),
+            ("json", False, False), ("json", True, True),
+            ("json", False, True)]}
+    ) == 5
+
+
+def test_points_json_matches_json_dumps(rng):
+    for k, d in [(0, 3), (1, 1), (7, 5), (64, 8)]:
+        pts = _pts(rng, k, d)
+        assert points_json(pts) == json.dumps(pts.tolist()).encode()
+
+
+def test_points_json_specials_match_json_dumps():
+    pts = np.array(
+        [
+            [0.0, -0.0, 1.0, -1.0],
+            [np.inf, -np.inf, np.nan, 0.5],
+            [1e16, 1e-4, 9.999999e15, 1.0000001e-4],
+            [np.float32(1e-45), np.float32(3.4e38), 123456.0, -7.25],
+        ],
+        dtype=np.float32,
+    )
+    assert points_json(pts) == json.dumps(pts.tolist()).encode()
+
+
+def test_native_and_python_encoders_agree(rng, monkeypatch):
+    from skyline_tpu.native import ROWS_CSV, ROWS_JSON, format_rows_native
+
+    pts = _pts(rng, 50, 6)
+    native_json = format_rows_native(pts, ROWS_JSON)
+    if native_json is None:
+        pytest.skip("native library unavailable")
+    assert native_json == bs._rows_python(pts, ROWS_JSON)
+    assert format_rows_native(pts, ROWS_CSV) == bs._rows_python(pts, ROWS_CSV)
+    # the pure-Python fallback passes the same identity grid
+    monkeypatch.setenv("SKYLINE_BODYSTORE_NATIVE", "0")
+    assert points_json(pts) == json.dumps(pts.tolist()).encode()
+
+
+def test_wire_builders_match_direct_serialization(rng):
+    store = SnapshotStore()
+    snap = store.publish(_pts(rng), partial=True, excluded_chips=[1])
+    assert json_prefix(snap, True) == _json_ref(snap, True)
+    assert json_prefix(snap, False) == _json_ref(snap, False)
+    assert csv_body(snap) == _csv_ref(snap)
+    # doc_head honors the points-last splice contract
+    doc = snap.to_doc(include_points=True)
+    assert list(doc)[-1] == "points"
+    assert {k: v for k, v in doc.items() if k != "points"} == snap.doc_head()
+
+
+# --------------------------------------------------------------------------
+# identity grid through the store (writer + cross-process reader view)
+# --------------------------------------------------------------------------
+
+
+def test_bodystore_identity_grid(rng, tmp_path):
+    """format × points × explain × partial/restored marker meta, writer
+    AND reader mapping, every version."""
+    store = SnapshotStore()
+    w = BodyStore(str(tmp_path / "bodystore.dat"), keep=2).attach(store)
+    r = BodyStoreReader(str(tmp_path / "bodystore.dat"))
+    metas = [{}, {"partial": True}, {"partial": True, "excluded_chips": [0]}]
+    try:
+        for i in range(6):
+            snap = store.publish(_pts(rng, 10 + i), **metas[i % len(metas)])
+            grid = [
+                (FMT_JSON_POINTS, _json_ref(snap, True)),
+                (FMT_JSON_NOPOINTS, _json_ref(snap, False)),
+                (FMT_JSON_POINTS_EXPLAIN, _json_ref(snap, True)),
+                (FMT_JSON_NOPOINTS_EXPLAIN, _json_ref(snap, False)),
+                (FMT_CSV, _csv_ref(snap)),
+            ]
+            for fmt, ref in grid:
+                assert w.get(snap.version, fmt) == ref
+                assert r.get(snap.version, fmt) == ref
+        stats = w.stats()
+        assert stats["publishes"] == 6 and stats["torn_reads"] == 0
+        assert r.stats()["hits"] == 30
+    finally:
+        w.close()
+        r.close()
+
+
+def test_bodystore_pure_python_identity_grid(rng, tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYLINE_BODYSTORE_NATIVE", "0")
+    store = SnapshotStore()
+    w = BodyStore(str(tmp_path / "bodystore.dat")).attach(store)
+    try:
+        snap = store.publish(_pts(rng), partial=True)
+        assert w.get(snap.version, FMT_JSON_POINTS) == _json_ref(snap, True)
+        assert w.get(snap.version, FMT_CSV) == _csv_ref(snap)
+        assert w.stats()["python_rows"] > 0
+        assert w.stats()["native_rows"] == 0
+    finally:
+        w.close()
+
+
+def test_in_memory_store_needs_no_file(rng):
+    store = SnapshotStore()
+    w = BodyStore(None).attach(store)
+    snap = store.publish(_pts(rng))
+    assert w.get(snap.version, FMT_JSON_POINTS) == _json_ref(snap, True)
+    assert w.get(snap.version + 1, FMT_JSON_POINTS) is None
+    assert w.stats()["misses"] == 1
+    w.close()
+
+
+# --------------------------------------------------------------------------
+# seqlock / fence / reclaim discipline: exact bytes or nothing
+# --------------------------------------------------------------------------
+
+
+def test_torn_overwrite_is_detected_not_served(rng, tmp_path):
+    """A frame whose span the ring has reclaimed must never be served from
+    the mmap: the reader sees fence/reclaim evidence and reports a miss."""
+    store = SnapshotStore()
+    # tiny ring: a couple of publishes wrap it
+    w = BodyStore(
+        str(tmp_path / "bodystore.dat"), data_bytes=8192, keep=1
+    ).attach(store)
+    r = BodyStoreReader(str(tmp_path / "bodystore.dat"))
+    try:
+        refs = {}
+        for _ in range(12):
+            snap = store.publish(_pts(rng, 30, 4))
+            refs[snap.version] = {
+                FMT_JSON_POINTS: _json_ref(snap, True),
+                FMT_CSV: _csv_ref(snap),
+            }
+        assert w.stats()["ring_wraps"] > 0
+        served = swept = 0
+        for v, per_fmt in refs.items():
+            for fmt, ref in per_fmt.items():
+                got = r.get(v, fmt)
+                if got is None:
+                    swept += 1  # reclaimed: honest miss
+                else:
+                    served += 1
+                    assert got == ref  # never torn bytes
+        assert served > 0 and swept > 0
+    finally:
+        w.close()
+        r.close()
+
+
+def test_seqlock_writer_in_flight_forces_retry_then_miss(rng, tmp_path):
+    store = SnapshotStore()
+    w = BodyStore(str(tmp_path / "bodystore.dat")).attach(store)
+    r = BodyStoreReader(str(tmp_path / "bodystore.dat"))
+    try:
+        snap = store.publish(_pts(rng))
+        eoff = w._slot_off(snap.version, FMT_CSV)
+        seq = struct.unpack_from("<Q", w._mm, eoff)[0]
+        struct.pack_into("<Q", w._mm, eoff, seq | 1)  # writer mid-update
+        assert r.get(snap.version, FMT_CSV) is None
+        assert r.stats()["retries"] > 0
+        struct.pack_into("<Q", w._mm, eoff, seq)  # settle; read succeeds
+        assert r.get(snap.version, FMT_CSV) == _csv_ref(snap)
+    finally:
+        w.close()
+        r.close()
+
+
+def test_fence_scribble_is_detected(rng, tmp_path):
+    store = SnapshotStore()
+    w = BodyStore(str(tmp_path / "bodystore.dat")).attach(store)
+    r = BodyStoreReader(str(tmp_path / "bodystore.dat"))
+    try:
+        snap = store.publish(_pts(rng))
+        eoff = w._slot_off(snap.version, FMT_CSV)
+        _, _, _, ln, frame, fence = bs._ENTRY.unpack_from(w._mm, eoff)
+        struct.pack_into("<Q", w._mm, frame, fence + 99)  # corrupt pre-fence
+        assert r.get(snap.version, FMT_CSV) is None
+        assert r.stats()["torn_reads"] > 0
+        struct.pack_into("<Q", w._mm, frame, fence)  # heal
+        assert r.get(snap.version, FMT_CSV) == _csv_ref(snap)
+    finally:
+        w.close()
+        r.close()
+
+
+def test_oversize_body_skips_ring_but_serves_in_process(rng, tmp_path):
+    store = SnapshotStore()
+    w = BodyStore(str(tmp_path / "bodystore.dat"), data_bytes=512).attach(
+        store
+    )
+    r = BodyStoreReader(str(tmp_path / "bodystore.dat"))
+    try:
+        snap = store.publish(_pts(rng, 64, 8))  # bodies far beyond 512B
+        assert w.stats()["oversize_skipped"] > 0
+        # the primary still serves from its retained bytes
+        assert w.get(snap.version, FMT_JSON_POINTS) == _json_ref(snap, True)
+        # the reader honestly misses
+        assert r.get(snap.version, FMT_JSON_POINTS) is None
+    finally:
+        w.close()
+        r.close()
+
+
+def test_reader_remaps_after_writer_recreate(rng, tmp_path):
+    path = str(tmp_path / "bodystore.dat")
+    store1 = SnapshotStore()
+    w1 = BodyStore(path).attach(store1)
+    snap1 = store1.publish(_pts(rng))
+    r = BodyStoreReader(path)
+    try:
+        assert r.get(snap1.version, FMT_CSV) == _csv_ref(snap1)
+        w1.close()
+        store2 = SnapshotStore()
+        w2 = BodyStore(path).attach(store2)  # primary restart: new file
+        snap2 = store2.publish(_pts(rng))
+        snap2b = store2.publish(_pts(rng))
+        try:
+            assert r.get(snap2b.version, FMT_CSV) == _csv_ref(snap2b)
+            assert r.stats()["remaps"] >= 1
+        finally:
+            w2.close()
+    finally:
+        r.close()
+
+
+# --------------------------------------------------------------------------
+# serve wiring: HTTP identity, counters, delta/SSE splices
+# --------------------------------------------------------------------------
+
+
+def _raw_get(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_server_serves_bodystore_bytes_identically(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=16)
+    body = BodyStore(None).attach(store)
+    srv = SkylineServer(store, deltas=ring, port=0, read_cache=0,
+                        bodystore=body)
+    try:
+        snap = store.publish(_pts(rng), partial=True)
+        for path, ref in (
+            ("/skyline", _json_ref(snap, True)),
+            ("/skyline?points=0", _json_ref(snap, False)),
+            ("/skyline?explain=1", _json_ref(snap, True)),
+            ("/skyline?format=csv", _csv_ref(snap)),
+        ):
+            status, got = _raw_get(srv.port, path)
+            assert status == 200
+            if "csv" in path:
+                assert got == ref
+            else:
+                assert got.split(b', "age_ms":')[0] == ref
+                json.loads(got)  # the spliced tail still parses
+        assert body.stats()["hits"] >= 4
+        # restored marker rides the tail even when the prefix is cached
+        store.restored = True
+        status, got = _raw_get(srv.port, "/skyline")
+        assert b'"restored": true' in got and json.loads(got)["restored"]
+        # counters surface as Prometheus families
+        status, metrics = _raw_get(srv.port, "/metrics")
+        assert b"skyline_serve_bodystore_hits_total" in metrics
+        assert b"skyline_serve_bodystore_torn_reads_total" in metrics
+        assert b"skyline_serve_bodystore_retries_total" in metrics
+        assert b"skyline_serve_read_cache_misses_total" in metrics
+    finally:
+        srv.close()
+        body.close()
+
+
+def test_deltas_response_is_byte_identical_to_json_dumps(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=16)
+    srv = SkylineServer(store, deltas=ring, port=0)
+    try:
+        store.publish(_pts(rng, 6, 3))
+        store.publish(_pts(rng, 7, 3))
+        status, got = _raw_get(srv.port, "/deltas?since=1")
+        assert status == 200
+        entered, left, head = ring.since(1)
+        rs = store.read()
+        expected = json.dumps(
+            {
+                "from_version": 1,
+                "to_version": head,
+                "resync": False,
+                "count_entered": int(entered.shape[0]),
+                "count_left": int(left.shape[0]),
+                "entered": entered.tolist(),
+                "left": left.tolist(),
+                "staleness_ms": round(rs.staleness_ms, 1),
+            }
+        ).encode()
+        # the spliced body equals json.dumps EXCEPT the volatile staleness
+        # stamp (time moved between the two reads) — compare up to it
+        cut = b', "staleness_ms": '
+        assert got.split(cut)[0] == expected.split(cut)[0]
+        json.loads(got)
+    finally:
+        srv.close()
+
+
+def test_delta_fragments_memoize_and_match(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=8)
+    store.publish(_pts(rng, 5, 3))
+    store.publish(_pts(rng, 6, 3))
+    tail = ring.latest()
+    assert tail.entered_json() == json.dumps(tail.entered.tolist()).encode()
+    assert tail.left_json() == json.dumps(tail.left.tolist()).encode()
+    assert tail.entered_json() is tail.entered_json()  # memoized
+
+
+def test_sse_delta_event_payload_matches_json_dumps(rng):
+    store = SnapshotStore()
+    ring = DeltaRing(store, capacity=8)
+    srv = SkylineServer(store, deltas=ring, port=0)
+    sock = None
+    try:
+        store.publish(_pts(rng, 5, 3))
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        sock.sendall(b"GET /subscribe HTTP/1.1\r\nHost: x\r\n\r\n")
+        time.sleep(0.3)  # let the subscriber register on the loop
+        snap = store.publish(_pts(rng, 6, 3), partial=True)
+        tail = ring.latest()
+        sock.settimeout(10)
+        buf = b""
+        while b"event: delta" not in buf or not buf.endswith(b"\n\n"):
+            chunk = sock.recv(65536)
+            assert chunk, f"stream closed early: {buf[-200:]!r}"
+            buf = buf + chunk
+        frame = buf.split(b"event: delta\n", 1)[1]
+        data = frame.split(b"data: ", 1)[1].split(b"\n\n", 1)[0]
+        expected = json.dumps(
+            {
+                "from_version": tail.from_version,
+                "to_version": tail.to_version,
+                "watermark_id": snap.watermark_id,
+                "entered": tail.entered.tolist(),
+                "left": tail.left.tolist(),
+                "meta": snap.meta,
+            }
+        ).encode()
+        assert data == expected
+    finally:
+        if sock is not None:
+            sock.close()
+        srv.close()
+
+
+def test_replica_style_server_serves_primary_bytes(rng, tmp_path):
+    """A server handed a BodyStoreReader (the --replica-of shape) serves
+    the PRIMARY's exact bytes for versions its own store also holds."""
+    path = str(tmp_path / "bodystore.dat")
+    primary_store = SnapshotStore()
+    w = BodyStore(path).attach(primary_store)
+    pts = _pts(rng, 12, 4)
+    psnap = primary_store.publish(pts, now_ms=123456.0)
+    # replica folds the same bytes (same version/timestamp via the WAL)
+    replica_store = SnapshotStore()
+    replica_store.restore_state(
+        psnap.points, psnap.version, psnap.watermark_id, psnap.timestamp_ms
+    )
+    reader = BodyStoreReader(path)
+    srv = SkylineServer(
+        replica_store, port=0, read_cache=0, role="replica", bodystore=reader
+    )
+    try:
+        status, got = _raw_get(srv.port, "/skyline?format=csv")
+        assert status == 200 and got == _csv_ref(psnap)
+        status, got = _raw_get(srv.port, "/skyline")
+        assert got.split(b', "age_ms":')[0] == _json_ref(psnap, True)
+        assert reader.stats()["hits"] >= 2
+    finally:
+        srv.close()
+        w.close()
+        reader.close()
