@@ -74,7 +74,7 @@ def rank_cascade_stamp() -> bool:
     silently drift from the dispatcher's (ADVICE.md round 5)."""
     from skyline_tpu.ops.dispatch import rank_cascade
 
-    return rank_cascade()
+    return rank_cascade()  # lint: allow-raw-gate
 
 
 def analysis_stamp() -> dict:
@@ -299,7 +299,8 @@ def sorted_sfs_leg(cfg, ids, x, required) -> dict:
         if e.get("kind") == "flush.dispatch":
             p = str(e.get("path", "unknown"))
             paths[p] = paths.get(p, 0) + 1
-    block: dict = {"mode": sorted_sfs_mode(), "dispatch_paths": paths}
+    mode = sorted_sfs_mode()  # lint: allow-raw-gate (provenance stamp)
+    block: dict = {"mode": mode, "dispatch_paths": paths}
     prof = eng.pset._flush_prof
     if prof is not None:
         block["flush_signatures"] = [
@@ -322,7 +323,7 @@ def device_cascade_leg() -> dict:
     flush = bench_cascade_flush(n=65536)
     auto = bench_cascade_auto()
     return {
-        "mode": device_cascade_mode(),
+        "mode": device_cascade_mode(),  # lint: allow-raw-gate
         "flush_device_ms": flush["device_flush_ms"],
         "flush_cascade_ms": flush["cascade_flush_ms"],
         "flush_speedup": flush["speedup"],
@@ -958,6 +959,19 @@ def child_main(backend: str) -> None:
             ops = {"error": f"{type(e).__name__}: {e}"}
     else:
         ops = {"skipped": True}
+    # dispatch-tuner leg: static-best vs controller regret under drift,
+    # digest identity asserted at every trigger (BENCH_TUNER=0 to skip;
+    # the full-scale grid lives in artifacts/tuner_ab.json —
+    # benchmarks/tuner.py, RUNBOOK §2v)
+    if env_bool("BENCH_TUNER", True):
+        try:
+            from benchmarks.tuner import run_ab
+
+            tuner = run_ab(rows_per_phase=3000, d=4, chunk=750)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            tuner = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        tuner = {"skipped": True}
     # replication lag for the ops-plane sentinel/gate: the replica leg's
     # real tail-lag quantiles, restated under the blocks whose dotted
     # paths the watchers resolve (cluster.replication_lag_p99_ms)
@@ -1052,6 +1066,7 @@ def child_main(backend: str) -> None:
                 "replica": replica,
                 "cluster": cluster,
                 "ops": ops,
+                "tuner": tuner,
                 "warmup_window_s": round(warm_dt, 2),
                 "phase_breakdown_ms": phases,
                 "sorted_sfs": sorted_sfs,

@@ -1,0 +1,247 @@
+"""A/B: static-best dispatch vs the closed-loop tuner under workload
+drift (ISSUE 20).
+
+The drifting leg streams uniform rows, then anti-correlated rows (the
+regime flip that inverts which mask/flush variant wins), through the SAME
+engine configuration five ways: three static forcings (scan, sorted
+cascade, device cascade), the untuned auto race, and the controller
+(``telemetry/tuner.py`` at an accelerated cadence). Every configuration
+answers the identical trigger schedule and the published skyline —
+count, survivor rows, point bytes — is asserted identical across ALL
+configurations at EVERY trigger before a single wall number is compared:
+the tuner may only ever move *when*, never *what*.
+
+``regret_fraction`` is the honest score: (tuned_wall - static_best_wall)
+/ static_best_wall, where static_best is picked *in hindsight* over the
+whole drifting stream. A controller that explores badly shows up as
+positive regret; one that adapts across the flip can beat every single
+static setting (negative regret). A stationary control leg (uniform
+only) checks the controller does no harm when there is nothing to adapt
+to. ``scripts/bench_compare.py`` and the sentinel gate ride on
+``regret_fraction``.
+
+The stationary number is noise-dominated on the CPU fallback (the
+growing-N schedule lands every few triggers in a fresh profiler
+n-bucket, so the auto race keeps re-exploring — a cost the untuned
+default pays identically; run-to-run spread is ~±0.2). The gates
+therefore ride the DRIFT regret, where the adaptation win dwarfs the
+noise floor; the stationary leg is a do-no-harm control, not a gate.
+
+Writes ``artifacts/tuner_ab.json``.
+
+Usage: python benchmarks/tuner.py [--rows-per-phase 8000] [--d 6]
+       [--chunk 1000] [--out artifacts/tuner_ab.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# fmt: off
+_BASE_ENV = {
+    "SKYLINE_TUNER": "0",
+    "SKYLINE_SORTED_SFS": "auto",
+    "SKYLINE_DEVICE_CASCADE": "auto",
+}
+CONFIGS = {
+    # name -> env deltas over _BASE_ENV
+    "static_scan":   {"SKYLINE_SORTED_SFS": "off", "SKYLINE_DEVICE_CASCADE": "off"},
+    "static_sorted": {"SKYLINE_SORTED_SFS": "on",  "SKYLINE_DEVICE_CASCADE": "off"},
+    "static_device": {"SKYLINE_SORTED_SFS": "off", "SKYLINE_DEVICE_CASCADE": "on"},
+    "auto_untuned":  {},
+    "tuned": {
+        "SKYLINE_TUNER": "1",
+        "SKYLINE_TUNER_EPOCH_S": "0",
+        "SKYLINE_TUNER_HYSTERESIS": "1",
+        "SKYLINE_WORKLOAD_EPOCH_ROWS": "1024",
+    },
+}
+# fmt: on
+_STATIC = ("static_scan", "static_sorted", "static_device")
+
+
+def _phases(kinds, rows_per_phase: int, d: int, seed: int = 7):
+    """The deterministic drift schedule: identical byte streams for every
+    configuration (one fresh rng per call)."""
+    from skyline_tpu.workload import generators as g
+
+    rng = np.random.default_rng(seed)
+    fns = {
+        "uniform": g.uniform,
+        "correlated": g.correlated,
+        "anti_correlated": g.anti_correlated,
+    }
+    return [(k, fns[k](rng, rows_per_phase, d, 0, 10000)) for k in kinds]
+
+
+def _digest(result: dict) -> str:
+    h = hashlib.sha256()
+    h.update(str(result.get("skyline_size")).encode())
+    pts = result.get("skyline_points")
+    if pts is not None:
+        h.update(
+            np.ascontiguousarray(
+                np.asarray(pts, dtype=np.float32)
+            ).tobytes()
+        )
+    return h.hexdigest()[:16]
+
+
+def _run_config(name: str, env: dict, phases, chunk: int, d: int):
+    """One full pass of the drift schedule under one env setting: fresh
+    engine, clean cascade table, per-trigger query wall + answer digest."""
+    from skyline_tpu.ops import cascade
+    from skyline_tpu.stream import EngineConfig, SkylineEngine
+    from skyline_tpu.telemetry import Telemetry
+
+    saved = {k: os.environ.get(k) for k in env}  # lint: allow-raw-env (save/restore)
+    os.environ.update(env)
+    cascade.clear_pins()
+    for k in cascade.TUNABLE_KNOBS:
+        cascade.clear_override(k)
+    try:
+        eng = SkylineEngine(
+            EngineConfig(
+                parallelism=2, algo="mr-angle", dims=d,
+                domain_max=10000.0, flush_policy="lazy",
+                emit_skyline_points=True,
+            ),
+            telemetry=Telemetry(),
+        )
+        digests, walls = [], []
+        ingested = 0
+        qid = 0
+        for _, x in phases:
+            ids = np.arange(
+                ingested, ingested + x.shape[0], dtype=np.int64
+            )
+            for i in range(0, x.shape[0], chunk):
+                eng.process_records(ids[i:i + chunk], x[i:i + chunk])
+                ingested += min(chunk, x.shape[0] - i)
+                qid += 1
+                t0 = time.perf_counter()
+                # required=0: ingest is synchronous, the barrier adds nothing
+                eng.process_trigger(f"{name}-{qid},0")
+                res = eng.poll_results()
+                walls.append((time.perf_counter() - t0) * 1e3)
+                assert len(res) == 1, f"{name}: trigger {qid} unanswered"
+                digests.append(_digest(res[0]))
+        tuner = getattr(eng, "tuner", None)
+        return {
+            "total_query_ms": round(sum(walls), 2),
+            "per_trigger_ms": [round(w, 3) for w in walls],
+            "digests": digests,
+            "tuner": None if tuner is None else tuner.doc(),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        cascade.clear_pins()
+        for k in cascade.TUNABLE_KNOBS:
+            cascade.clear_override(k)
+
+
+def _ab(kinds, rows_per_phase: int, d: int, chunk: int) -> dict:
+    """Run every configuration over one drift schedule; byte-identity is
+    asserted across configurations per trigger BEFORE any wall compare."""
+    runs = {}
+    for name, deltas in CONFIGS.items():
+        env = dict(_BASE_ENV)
+        env.update(deltas)
+        runs[name] = _run_config(
+            name, env, _phases(kinds, rows_per_phase, d), chunk, d
+        )
+    ref = runs["static_scan"]["digests"]
+    for name, r in runs.items():
+        assert r["digests"] == ref, (
+            f"answer digests diverge: {name} vs static_scan — the tuner "
+            "moved WHAT was computed, not just when"
+        )
+    static_best = min(_STATIC, key=lambda n: runs[n]["total_query_ms"])
+    best_ms = runs[static_best]["total_query_ms"]
+    tuned_ms = runs["tuned"]["total_query_ms"]
+    return {
+        "phases": list(kinds),
+        "rows_per_phase": rows_per_phase,
+        "d": d,
+        "chunk": chunk,
+        "triggers": len(ref),
+        "digest_identical": True,
+        "configs": {
+            n: {
+                "total_query_ms": r["total_query_ms"],
+                "per_trigger_ms": r["per_trigger_ms"],
+            }
+            for n, r in runs.items()
+        },
+        "static_best": static_best,
+        "static_best_ms": best_ms,
+        "auto_untuned_ms": runs["auto_untuned"]["total_query_ms"],
+        "tuned_ms": tuned_ms,
+        "tuner": runs["tuned"]["tuner"],
+        "regret_fraction": round(
+            (tuned_ms - best_ms) / best_ms if best_ms > 0 else 0.0, 4
+        ),
+        # tuned/static_best wall ratio (= 1 + regret): strictly positive,
+        # lower is better — the form scripts/bench_compare.py's ratio
+        # math can gate on (regret_fraction crosses zero)
+        "regret_factor": round(
+            tuned_ms / best_ms if best_ms > 0 else 1.0, 4
+        ),
+    }
+
+
+def run_ab(rows_per_phase: int = 8000, d: int = 6, chunk: int = 1000) -> dict:
+    """The full A/B document (drift leg + stationary control) — also the
+    entry point ``bench.py``'s tuner leg calls at reduced scale."""
+    drift = _ab(("uniform", "anti_correlated"), rows_per_phase, d, chunk)
+    stationary = _ab(("uniform",), rows_per_phase, d, chunk)
+    return {
+        "drift": drift,
+        "stationary": stationary,
+        # the headline gate: hindsight regret under drift
+        "regret_fraction": drift["regret_fraction"],
+        "regret_factor": drift["regret_factor"],
+        "stationary_regret_fraction": stationary["regret_fraction"],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows-per-phase", type=int, default=8000)
+    ap.add_argument("--d", type=int, default=6)
+    ap.add_argument("--chunk", type=int, default=1000)
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "artifacts", "tuner_ab.json")
+    )
+    args = ap.parse_args()
+    doc = run_ab(args.rows_per_phase, args.d, args.chunk)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+    d = doc["drift"]
+    print(
+        f"tuner A/B: static_best={d['static_best']} "
+        f"({d['static_best_ms']:.1f} ms) tuned={d['tuned_ms']:.1f} ms "
+        f"regret={doc['regret_fraction']:+.3f} "
+        f"stationary={doc['stationary_regret_fraction']:+.3f} "
+        f"(digest identical at {d['triggers']} triggers)"
+    )
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,11 @@ METRICS = (
     # a drill number. Absent on pre-ops artifacts -> skipped
     ("cluster.replication_lag_p99_ms",
      ("cluster", "replication_lag_p99_ms"), False, False),
+    # dispatch-tuner leg (ISSUE 20): tuned/static-best wall ratio under
+    # workload drift (1 + regret_fraction; strictly positive so the
+    # ratio math here stays sign-safe). Creeping up means the controller
+    # is losing to a static setting it should at worst match.
+    ("tuner.regret_factor", ("tuner", "regret_factor"), False, False),
 )
 
 

@@ -492,6 +492,142 @@ print(f"[obs-smoke] device cascade ok: live under jit "
       "and off")
 EOF
 
+# dispatch cascade + closed-loop tuner (ISSUE 20, RUNBOOK §2v): drive a
+# uniform -> anti-correlated drift through a live worker with the
+# controller at accelerated cadence — the workload plane must count the
+# drift (skyline_workload_drift_total), the tuner must leave a decision
+# in the flight ring and serve its block on GET /dispatch on BOTH HTTP
+# surfaces, and an engine-level on/off re-run of the same stream must
+# publish byte-identical skylines (the controller moves WHEN work
+# happens, never WHAT is computed)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import hashlib
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+os.environ["SKYLINE_TUNER"] = "1"
+os.environ["SKYLINE_TUNER_EPOCH_S"] = "0"
+os.environ["SKYLINE_TUNER_HYSTERESIS"] = "1"
+# several epochs must close per phase for the kind flip to register:
+# sample every row (cap above the 1500-row phases) and close every 256
+os.environ["SKYLINE_WORKLOAD_EPOCH_ROWS"] = "256"
+os.environ["SKYLINE_WORKLOAD_SAMPLE_CAP"] = "2000"
+
+from skyline_tpu.bridge import MemoryBus, SkylineWorker
+from skyline_tpu.bridge.wire import format_trigger, format_tuple_line
+from skyline_tpu.utils.config import parse_job_args
+from skyline_tpu.workload.generators import anti_correlated, uniform
+
+
+def _phases(d):
+    rng = np.random.default_rng(11)
+    return [uniform(rng, 1500, d, 0, 10000),
+            anti_correlated(rng, 1500, d, 0, 10000)]
+
+
+cfg = parse_job_args(["--serve", "0", "--stats-port", "0",
+                      "--parallelism", "2", "--dims", "4"])
+bus = MemoryBus()
+worker = SkylineWorker(bus, cfg.engine_config(), stats_port=cfg.stats_port,
+                       serve_port=cfg.serve_port,
+                       serve_config=cfg.serve_config())
+try:
+    rid = 0
+    for qid, x in enumerate(_phases(4)):
+        bus.produce_many("input-tuples",
+                         [format_tuple_line(rid + i, row)
+                          for i, row in enumerate(x)])
+        rid += len(x)
+        bus.produce("queries", format_trigger(qid, 0))
+        while worker.step() > 0:
+            pass
+    for _ in range(4):  # idle ticks drive maybe_tune at zero cadence
+        worker.step()
+
+    counters = dict(worker.telemetry.counters.snapshot())
+    assert counters.get("workload.drift", 0) >= 1, \
+        "regime flip never counted as drift"
+    assert counters.get("tuner.epochs", 0) >= 1, \
+        "controller never ran an epoch"
+
+    stats_base = f"http://127.0.0.1:{worker.stats_server.port}"
+    serve_base = f"http://127.0.0.1:{worker.serve_server.port}"
+    with urllib.request.urlopen(f"{stats_base}/metrics", timeout=5) as r:
+        body = r.read().decode()
+    for want in ("skyline_workload_drift_total",
+                 "skyline_tuner_epochs_total",
+                 "skyline_tuner_moves_total",
+                 "skyline_tuner_switches_total"):
+        assert want in body, f"{want} missing from exposition"
+
+    for base in (stats_base, serve_base):
+        with urllib.request.urlopen(f"{base}/dispatch", timeout=5) as r:
+            doc = json.load(r)
+        assert doc["table"]["rows"], "cascade table empty on /dispatch"
+        assert doc["tuner"]["enabled"] is True, doc["tuner"]
+        assert doc["tuner"]["epochs"] >= 1, doc["tuner"]
+
+    with urllib.request.urlopen(f"{stats_base}/debug/flight",
+                                timeout=5) as r:
+        kinds = {e["kind"] for e in json.load(r)["entries"]}
+    assert "workload.drift" in kinds, sorted(kinds)
+    assert any(k.startswith("tuner.") for k in kinds), sorted(kinds)
+    tuner_doc = doc["tuner"]
+    print(f"[obs-smoke] tuner live ok: {counters['workload.drift']:.0f} "
+          f"drift(s) counted, {tuner_doc['epochs']} controller epoch(s), "
+          f"{tuner_doc['switches']} regime switch(es), decision kinds "
+          f"{sorted(k for k in kinds if k.startswith('tuner.'))} "
+          f"on /debug/flight, /dispatch live on both surfaces")
+finally:
+    worker.close()
+
+# engine-level identity: same drift stream, tuner on vs off, published
+# skyline (count + point bytes) must match digest-for-digest per trigger
+from skyline_tpu.ops import cascade
+from skyline_tpu.stream import EngineConfig, SkylineEngine
+from skyline_tpu.telemetry import Telemetry
+
+digests = {}
+for mode in ("1", "0"):
+    os.environ["SKYLINE_TUNER"] = mode
+    cascade.clear_pins()
+    for k in cascade.TUNABLE_KNOBS:
+        cascade.clear_override(k)
+    eng = SkylineEngine(
+        EngineConfig(parallelism=2, algo="mr-angle", dims=4,
+                     domain_max=10000.0, flush_policy="lazy",
+                     emit_skyline_points=True),
+        telemetry=Telemetry(),
+    )
+    out = []
+    ingested = 0
+    for qid, x in enumerate(_phases(4)):
+        ids = np.arange(ingested, ingested + len(x), dtype=np.int64)
+        eng.process_records(ids, x)
+        ingested += len(x)
+        eng.process_trigger(f"tuner-smoke-{qid},0")
+        res = eng.poll_results()
+        assert len(res) == 1, f"trigger {qid} unanswered"
+        h = hashlib.sha256()
+        h.update(str(res[0]["skyline_size"]).encode())
+        pts = res[0].get("skyline_points")
+        if pts is not None:
+            h.update(np.ascontiguousarray(
+                np.asarray(pts, dtype=np.float32)).tobytes())
+        out.append(h.hexdigest()[:16])
+    digests[mode] = out
+cascade.clear_pins()
+for k in cascade.TUNABLE_KNOBS:
+    cascade.clear_override(k)
+assert digests["1"] == digests["0"], \
+    "tuner on/off published skylines diverge (controller moved WHAT)"
+print(f"[obs-smoke] tuner digest ok: {len(digests['1'])} trigger(s) "
+      "byte-identical with the controller on and off")
+EOF
+
 # replicated read fleet (RUNBOOK §2q): a WAL-tailing replica must expose
 # the full serve surface byte-identically (role-marked /healthz, labeled
 # per-tenant admission families on /metrics, SSE delta push on

@@ -21,10 +21,20 @@ scripts, driver entry — tests excluded) enforcing:
 - ``bool-compare`` (error): comparing an env/accessor string against a
   truthiness literal (``env_str(...) != "0"``) — the pattern that gave
   different call sites different ideas of ``"false"``; use ``env_bool``.
+- ``raw-applicability`` (error, ISSUE 20): a call to one of the dispatch
+  gate helpers (``merge_tree_enabled``, ``chip_prune_enabled``, ...)
+  outside ``ops/cascade.py`` / ``ops/dispatch.py``. The cascade table is
+  the single source of truth for variant/path/gate applicability —
+  engines must resolve through ``cascade.gate/applies/merge_*`` so tuner
+  overrides and pins are honored everywhere; a raw gate call silently
+  forks the decision.
 
 Suppression: a line containing ``# lint: allow-raw-env`` is exempt from
 ``raw-env-read`` / ``dynamic-knob-name`` (used by the benchmark
-save/flip/restore idiom that snapshots knob values by name).
+save/flip/restore idiom that snapshots knob values by name); a line
+containing ``# lint: allow-raw-gate`` is exempt from
+``raw-applicability`` (A/B harnesses comparing a gate's legacy default
+against the table-resolved value).
 """
 
 from __future__ import annotations
@@ -36,6 +46,25 @@ from skyline_tpu.analysis.findings import Finding
 from skyline_tpu.analysis.registry import ACCESSORS, _BY_NAME
 
 SUPPRESS = "# lint: allow-raw-env"
+SUPPRESS_GATE = "# lint: allow-raw-gate"
+
+# dispatch gate helpers whose calls must stay inside the cascade table
+# (ops/cascade.py) or their defining module (ops/dispatch.py). Anything
+# else resolving applicability from these raw gates bypasses the table's
+# tuner overrides/pins and forks the dispatch decision.
+GATE_HELPERS = frozenset((
+    "merge_cache_enabled", "merge_tree_enabled", "merge_prune_enabled",
+    "chip_prune_enabled", "host_prune_enabled", "flush_prefilter_enabled",
+    "sorted_sfs_mode", "device_cascade_mode", "delta_dirty_cutoff",
+    "rank_cascade",
+))
+
+# modules allowed to call the gate helpers directly: the table itself and
+# the module that defines them
+_TABLE_SUFFIXES = (
+    os.path.join("ops", "cascade.py"),
+    os.path.join("ops", "dispatch.py"),
+)
 
 # os.environ methods that only read single values (flagged) vs. passthrough
 # or write methods (allowed)
@@ -89,17 +118,31 @@ def _accessor_name(node: ast.Call) -> str | None:
     return None
 
 
+def _gate_helper_name(node: ast.Call) -> str | None:
+    """The called dispatch-gate helper's name, or None. Matches both the
+    bare import (``merge_tree_enabled()``) and the attribute form
+    (``dispatch.merge_tree_enabled()``)."""
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in GATE_HELPERS:
+        return f.id
+    if isinstance(f, ast.Attribute) and f.attr in GATE_HELPERS:
+        return f.attr
+    return None
+
+
 class _FileLint(ast.NodeVisitor):
-    def __init__(self, path: str, rel: str, source: str, is_registry: bool):
+    def __init__(self, path: str, rel: str, source: str, is_registry: bool,
+                 is_table: bool = False):
         self.rel = rel
         self.lines = source.splitlines()
         self.is_registry = is_registry
+        self.is_table = is_table
         self.findings: list[Finding] = []
         self.reads: set[str] = set()  # knob names read via accessor
 
-    def _suppressed(self, node: ast.AST) -> bool:
+    def _suppressed(self, node: ast.AST, marker: str = SUPPRESS) -> bool:
         for ln in range(node.lineno, getattr(node, "end_lineno", node.lineno) + 1):
-            if ln - 1 < len(self.lines) and SUPPRESS in self.lines[ln - 1]:
+            if ln - 1 < len(self.lines) and marker in self.lines[ln - 1]:
                 return True
         return False
 
@@ -173,6 +216,18 @@ class _FileLint(ast.NodeVisitor):
                 "os.environ read outside the registry accessor "
                 "(use skyline_tpu.analysis.registry.env_*)",
             )
+        gate = _gate_helper_name(node)
+        if (
+            gate is not None
+            and not self.is_table
+            and not self._suppressed(node, SUPPRESS_GATE)
+        ):
+            self._flag(
+                node, "raw-applicability",
+                f"{gate}() called outside the cascade table — resolve "
+                "through skyline_tpu.ops.cascade (gate/applies/merge_*/"
+                "resolve_*) so tuner overrides and pins apply",
+            )
         acc = _accessor_name(node)
         if acc is not None:
             if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
@@ -228,8 +283,10 @@ def lint_paths(roots, base: str | None = None):
             )
             continue
         rel = os.path.relpath(path, base)
-        is_registry = os.path.abspath(path).endswith(_REGISTRY_SUFFIX)
-        lint = _FileLint(path, rel, source, is_registry)
+        apath = os.path.abspath(path)
+        is_registry = apath.endswith(_REGISTRY_SUFFIX)
+        is_table = any(apath.endswith(sfx) for sfx in _TABLE_SUFFIXES)
+        lint = _FileLint(path, rel, source, is_registry, is_table=is_table)
         lint.visit(tree)
         findings.extend(lint.findings)
         reads |= lint.reads

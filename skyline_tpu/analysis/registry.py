@@ -587,6 +587,30 @@ KNOBS: tuple[Knob, ...] = (
        "per-dim p50 shift (normalized by the frozen sketch range) beyond "
        "which consecutive epochs count as drift", "telemetry",
        runbook="§2o"),
+    # -- closed-loop dispatch tuner (telemetry/tuner.py, ops/cascade.py) ---
+    _k("SKYLINE_TUNER", "bool", True,
+       "closed-loop dispatch tuner over the cascade table: pins measured "
+       "EMA winners per signature and retunes table-scoped knobs per "
+       "workload regime (0 = static dispatch, the A/B baseline)",
+       "engine", runbook="§2v"),
+    _k("SKYLINE_TUNER_EPOCH_S", "float", 5.0,
+       "min seconds between controller epochs (the tuner is also passive "
+       "until the first workload epoch closes)", "engine", runbook="§2v"),
+    _k("SKYLINE_TUNER_HYSTERESIS", "int", 2,
+       "consecutive controller epochs a new workload regime must persist "
+       "before the tuner switches context (drift-flip damping)",
+       "engine", runbook="§2v"),
+    _k("SKYLINE_TUNER_MAX_MOVES", "int", 2,
+       "max pin/knob moves per controller epoch (bounded-move rule)",
+       "engine", runbook="§2v"),
+    _k("SKYLINE_TUNER_CUTOFF_STEP", "float", 0.1,
+       "max delta-cutoff movement per controller epoch when steering "
+       "toward the observed dirty-fraction quantile", "engine",
+       runbook="§2v"),
+    _k("SKYLINE_TUNER_EXPLORE_ON_DRIFT", "bool", True,
+       "on a confirmed regime switch with no banked state, reset the "
+       "mask/flush profiler signatures so the variant race re-runs under "
+       "the new distribution", "engine", runbook="§2v"),
     _k("SKYLINE_SENTINEL_WINDOW", "int", 4,
        "perf-trajectory sentinel: rolling-baseline window (newest "
        "artifact compared against the median of up to N prior comparable "
@@ -651,6 +675,10 @@ KNOBS: tuple[Knob, ...] = (
     _k("BENCH_CLUSTER", "bool", True,
        "run the cluster-plane bench leg (host-prune probe + promotion "
        "drill)", "bench", runbook="§2r"),
+    _k("BENCH_TUNER", "bool", True,
+       "run the dispatch-tuner A/B leg (benchmarks/tuner.py static-best "
+       "vs controller under drift, byte-identity asserted before timing)",
+       "bench", runbook="§2v"),
     _k("BENCH_OPS", "bool", True,
        "run the ops-plane bench leg (journal append cost + clusterview "
        "scrape wall)", "bench", runbook="§2s"),

@@ -239,6 +239,10 @@ class SkylineWorker:
         self._queries = bus.consumer(query_topic, from_beginning=False)
         self.results_emitted = 0
         if resilience is not None:
+            # warm the learned-dispatch planes BEFORE replay so the replay
+            # flushes themselves run under the checkpointed winners
+            # instead of re-paying cold exploration (PR 18 scoping note)
+            self._restore_dispatch_state(restored_meta)
             self._replay(restored_meta, wal_records)
         self.serve_server = None
         self._serve_bridge = None
@@ -774,6 +778,48 @@ class SkylineWorker:
             rec["snap"] = snapshot_wal_record(snap)
         return rec
 
+    def _dispatch_state(self) -> dict:
+        """The learned-dispatch extra-meta block: kernel-profiler state
+        (hub profiler + the PartitionSet's separate flush-chooser
+        profiler) and the dispatch tuner's learned pins/overrides. All
+        JSON-safe; absent planes contribute nothing."""
+        out: dict = {}
+        prof = getattr(self.engine, "profiler", None)
+        if prof is not None and hasattr(prof, "export_state"):
+            out["profiler"] = prof.export_state()
+        pset = getattr(self.engine, "pset", None)
+        fprof = getattr(pset, "_flush_prof", None) if pset is not None else None
+        if fprof is not None and hasattr(fprof, "export_state"):
+            out["flush_profiler"] = fprof.export_state()
+        tuner = getattr(self.engine, "tuner", None)
+        if tuner is not None:
+            out["tuner"] = tuner.state_doc()
+        return out
+
+    def _restore_dispatch_state(self, meta: dict | None) -> None:
+        """Re-adopt the checkpointed learned-dispatch state into the LIVE
+        engine's planes (the restored engine shares the hub profiler the
+        checkpoint exported from). Live measurements win over restored
+        ones; the tuner re-validates every pin against the cascade
+        table's oracle rule."""
+        if meta is None:
+            return
+        extra = meta.get("extra", {})
+        prof = getattr(self.engine, "profiler", None)
+        if prof is not None and hasattr(prof, "restore_state"):
+            prof.restore_state(extra.get("profiler"))
+        fstate = extra.get("flush_profiler")
+        pset = getattr(self.engine, "pset", None)
+        if fstate and pset is not None:
+            if getattr(pset, "_flush_prof", None) is None:
+                from skyline_tpu.telemetry.profiler import KernelProfiler
+
+                pset._flush_prof = KernelProfiler()
+            pset._flush_prof.restore_state(fstate)
+        tuner = getattr(self.engine, "tuner", None)
+        if tuner is not None:
+            tuner.restore(extra.get("tuner"))
+
     def checkpoint_now(self) -> str | None:
         """Atomic checkpoint + WAL barrier (rotate, log the serve head,
         truncate everything the checkpoint now covers)."""
@@ -784,6 +830,12 @@ class SkylineWorker:
             extra_meta={
                 "data_off": self._data_pos,
                 "query_off": self._query_pos,
+                # learned-dispatch plane (ISSUE 20): profiler EMAs (hub +
+                # the flush chooser's separate per-set profiler) and the
+                # tuner's pins/overrides ride the checkpoint so a
+                # supervised restart resumes tuned instead of paying the
+                # cold exploration flushes again
+                **self._dispatch_state(),
             },
         )
         if self._wal is not None:
@@ -1163,6 +1215,12 @@ class SkylineWorker:
                     pset = getattr(self.engine, "pset", None)
                     if pset is not None and hasattr(pset, "maybe_failover"):
                         pset.maybe_failover()
+                # idle ticks drive the dispatch tuner too: a quiet stream
+                # still closes workload epochs, and the controller must
+                # converge (or revert on SLO burn) without a query
+                tuner = getattr(self.engine, "tuner", None)
+                if tuner is not None:
+                    tuner.maybe_tune()
                 time.sleep(idle_sleep_s)
             else:
                 idle_since = None

@@ -46,7 +46,7 @@ import numpy as np
 from skyline_tpu.cluster.merge import host_leaf, prune_hosts, tournament
 from skyline_tpu.distributed.sharded import ShardedPartitionSet, epoch_hex
 from skyline_tpu.metrics.tracing import NULL_TRACER
-from skyline_tpu.ops.dispatch import host_prune_enabled, merge_cache_enabled
+from skyline_tpu.ops import cascade
 from skyline_tpu.stream.batched import PartitionSet, PartitionView
 from skyline_tpu.stream.engine import SkylineEngine
 from skyline_tpu.stream.window import (
@@ -367,7 +367,7 @@ class ClusterPartitionSet:
         h.emit_points = emit_points
         h.key = self.epoch_key
         h.explain, self._explain = self._explain, None
-        use_cache = merge_cache_enabled()
+        use_cache = cascade.merge_cache_on(False)
         h.use_cache = use_cache
         cache = self._gm_cache if use_cache else None
         if cache is not None and cache["key"] == h.key:
@@ -393,7 +393,7 @@ class ClusterPartitionSet:
             return h
         self.merge_cache_misses += 1
         P, H, G, d = self.num_partitions, self.hosts, self.group_size, self.dims
-        want_prune = host_prune_enabled() and H > 1
+        want_prune = cascade.gate("host_prune") and H > 1
         trace_id = h.explain.trace_id if h.explain is not None else None
         host_counts: list[np.ndarray] = []
         host_surv: list[np.ndarray] = []

@@ -48,13 +48,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
+from skyline_tpu.ops import cascade
 from skyline_tpu.ops.dispatch import (
     chip_failover_enabled,
     chip_merge_deadline_ms,
-    chip_prune_enabled,
     failover_lock_ms,
     fleet_enabled,
-    merge_cache_enabled,
 )
 from skyline_tpu.parallel.chips import chip_devices
 from skyline_tpu.resilience.faults import InjectedCrash, fault_point
@@ -432,7 +431,7 @@ class ShardedPartitionSet:
         h.emit_points = emit_points
         h.key = self.epoch_key
         h.explain, self._explain = self._explain, None
-        use_cache = merge_cache_enabled()
+        use_cache = cascade.merge_cache_on(False)
         h.use_cache = use_cache
         cache = self._gm_cache if use_cache else None
         if cache is not None and cache["key"] == h.key:
@@ -468,7 +467,7 @@ class ShardedPartitionSet:
         chip_g: list[int] = []
         chip_pts: list = []  # (w_c, d) device buffer on chip c, or None
         chip_summary: list[np.ndarray | None] = []
-        want_prune = chip_prune_enabled() and C > 1
+        want_prune = cascade.gate("chip_prune") and C > 1
         trace_id = h.explain.trace_id if h.explain is not None else None
         deadline_ms = chip_merge_deadline_ms()
         bounded = deadline_ms > 0 and C > 1

@@ -221,6 +221,13 @@ class StatsServer:
                     else:
                         code, doc = outer._audit_doc(qs)
                         handler._reply(code, doc)
+                elif path == "/dispatch":
+                    # the declarative cascade table + live tuner decisions
+                    # (ISSUE 20) — works even without a hub: the table is
+                    # module state, only the tuner block needs telemetry
+                    from skyline_tpu.telemetry.tuner import dispatch_doc
+
+                    handler._reply(200, dispatch_doc(outer.telemetry))
                 elif path == "/fleet":
                     if outer.telemetry is None:
                         handler._reply(404, {"error": "no telemetry hub"})

@@ -1,0 +1,550 @@
+"""The declarative dispatch cascade table — one source of truth for every
+variant/path/gate choice the engine makes.
+
+Seven perf PRs grew a gated-variant matrix (sweep/SFS/sorted-SFS/device-
+cascade, tree vs flat merge, grid prefilter, bf16, cache/delta, chip/host
+prune) spread across ad-hoc env checks and two separate ``choose_variant``
+call sites. This module collapses that into ONE table: each stage/variant
+is a :class:`CascadeRow` declaring its applicability (backend, dimension
+bounds, traced/meshed legality), the legacy knob that gates it, the
+KernelProfiler signature its cost is measured under, the knobs a tuner may
+move for it, and — crucially — the **byte-identity oracle** that proves
+the row interchangeable with its siblings. The five legacy dispatch sites
+(``dispatch.skyline_mask_auto``, the lazy-flush chooser, the global-merge
+path decision, and the chip/host prune gates) all resolve here.
+
+Resolution semantics are EXACTLY the historical ones (tests/
+test_cascade_table.py pins the grid): explicit env modes force or exclude
+rows first, ``auto`` races the applicable candidates through the measured
+profiler EMAs (``dispatch.choose_variant`` sticky exploration), traced
+call sites only swap on existing measured evidence. On top of that sit two
+tuner surfaces, both inert until an online controller writes them:
+
+- **pins**: a learned per-(stage, d, N-bucket, backend, mp) winner that
+  short-circuits the EMA race. A pin is accepted ONLY for a row whose
+  byte-identity oracle is registered in :data:`ORACLES` — the audit-plane
+  hard rule — and only among the candidates the legacy logic would have
+  raced anyway, so a pin can never select a row an env knob excluded.
+- **overrides**: table-scoped knob values (delta cutoff, prefilter, tree)
+  consulted by the ``_eff_*`` readers. An EXPLICIT env setting always
+  wins: ``set_override`` refuses env-pinned knobs and the readers
+  re-check at read time, so an operator export beats the controller
+  mid-flight without a restart.
+
+``table_doc()`` renders the whole thing (rows, oracles, pins, overrides)
+for ``GET /dispatch`` on both HTTP surfaces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+from skyline_tpu.ops.dispatch import (
+    choose_variant,
+    chip_prune_enabled,
+    delta_dirty_cutoff,
+    device_cascade_mode,
+    flush_prefilter_enabled,
+    host_prune_enabled,
+    merge_cache_enabled,
+    merge_prune_enabled,
+    merge_tree_enabled,
+    on_tpu,
+    rank_cascade,
+    sorted_sfs_mode,
+)
+from skyline_tpu.telemetry.profiler import n_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeRow:
+    """One variant/path/gate of the dispatch cascade.
+
+    ``name`` is the KernelProfiler variant signature where applicable
+    (the closed vocabulary in ``stream/window.py KERNEL_VARIANTS``);
+    ``gate`` names the legacy env knob that forces/excludes the row;
+    ``oracle`` keys :data:`ORACLES` — rows without a registered oracle
+    exist (observability) but can never be tuner-pinned."""
+
+    name: str
+    stage: str                      # mask | flush | merge | gate
+    backends: tuple = ("*",)        # "tpu" | "host" (non-TPU) | "*"
+    d_min: int = 1
+    d_max: int | None = None        # inclusive; None = unbounded
+    traced_ok: bool = True          # legal under a jax tracer
+    mesh_ok: bool = False           # legal when a device mesh is attached
+    gate: str | None = None         # controlling env knob, if any
+    oracle: str | None = None       # byte-identity oracle id (ORACLES key)
+    knobs: tuple = ()               # tuner-movable knobs scoped to the row
+    doc: str = ""
+
+
+# Byte-identity oracles: id -> how interchangeability with the row's
+# sibling candidates is proven. The tuner's hard rule: it may only pin or
+# re-knob rows whose oracle id appears here (audit-plane verifiable).
+ORACLES: dict[str, str] = {
+    "host_oracle": (
+        "exact NumPy double-loop skyline (tests/conftest host oracle); "
+        "every mask/flush variant's survivor set is asserted equal in "
+        "tests/test_cascade_table.py and the sampled audit plane"
+    ),
+    "merge_digest": (
+        "published-state digest equality across cache/delta/tree/flat "
+        "merge paths (merge law + stable compaction order; "
+        "scripts/obs_smoke.sh digest legs, tests/test_merge_tree.py)"
+    ),
+    "prune_identity": (
+        "witness-dominance soundness: a pruned partition/chip/host "
+        "contributes no skyline point, so pruned and unpruned merges "
+        "publish identical bytes (RUNBOOK §2g/§2p; A/B digest checks in "
+        "benchmarks and obs_smoke)"
+    ),
+}
+
+
+TABLE: tuple[CascadeRow, ...] = (
+    # -- mask stage (dispatch.skyline_mask_auto) ---------------------------
+    CascadeRow(
+        "mask_sweep", "mask", d_max=2, oracle="host_oracle",
+        doc="d<=2 sort + prefix-min sweep; unconditional, every backend",
+    ),
+    CascadeRow(
+        "mask_pallas", "mask", backends=("tpu",), d_min=3,
+        oracle="host_oracle",
+        doc="quadratic Pallas sum-sorted tiles (TPU default kernel)",
+    ),
+    CascadeRow(
+        "mask_rank_pallas", "mask", backends=("tpu",), d_min=3,
+        gate="SKYLINE_RANK_CASCADE", oracle="host_oracle",
+        doc="Pallas dense-rank cascade; replaces mask_pallas when forced",
+    ),
+    CascadeRow(
+        "mask_device_cascade", "mask", d_min=3,
+        gate="SKYLINE_DEVICE_CASCADE", oracle="host_oracle",
+        doc="device sorted dominance cascade; jit-safe, all backends",
+    ),
+    CascadeRow(
+        "sorted_sfs_mask", "mask", backends=("host",), d_min=3,
+        traced_ok=False, gate="SKYLINE_SORTED_SFS", oracle="host_oracle",
+        doc="host sorted-order SFS cascade; concrete non-TPU arrays only",
+    ),
+    CascadeRow(
+        "mask_scan", "mask", backends=("host",), d_min=3,
+        oracle="host_oracle",
+        doc="lax.scan dominance kernel; the non-TPU device fallback",
+    ),
+    # -- flush stage (PartitionSet._choose_lazy_path) ----------------------
+    CascadeRow(
+        "flush_sorted_sfs", "flush", backends=("host",), traced_ok=False,
+        gate="SKYLINE_SORTED_SFS", oracle="host_oracle",
+        doc="whole lazy flush via the host sorted cascade",
+    ),
+    CascadeRow(
+        "flush_device_cascade", "flush", gate="SKYLINE_DEVICE_CASCADE",
+        oracle="host_oracle",
+        doc="whole lazy flush via the device sorted cascade; candidates "
+            "only when the host cascade is out of play (TPU or sorted=off)",
+    ),
+    CascadeRow(
+        "flush_sfs_vmapped", "flush", mesh_ok=True, oracle="host_oracle",
+        doc="one vmapped SFS round per flush level (balanced loads)",
+    ),
+    CascadeRow(
+        "flush_sfs_sequential", "flush", oracle="host_oracle",
+        doc="per-partition SFS rounds (routing skew)",
+    ),
+    # -- merge stage (global_merge_launch path) ----------------------------
+    CascadeRow(
+        "merge_cache_hit", "merge", gate="SKYLINE_MERGE_CACHE",
+        oracle="merge_digest",
+        doc="epoch-keyed exact cache hit: zero kernel launches",
+    ),
+    CascadeRow(
+        "merge_tree_delta", "merge", d_min=3, gate="SKYLINE_MERGE_TREE",
+        oracle="merge_digest", knobs=("SKYLINE_DELTA_CUTOFF",),
+        doc="cached_global ∪ dirty partitions up the pruned tree",
+    ),
+    CascadeRow(
+        "merge_delta", "merge", gate="SKYLINE_MERGE_CACHE",
+        oracle="merge_digest", knobs=("SKYLINE_DELTA_CUTOFF",),
+        doc="flat cached_global ∪ dirty merge below the cutoff",
+    ),
+    CascadeRow(
+        "merge_tree", "merge", d_min=3, gate="SKYLINE_MERGE_TREE",
+        oracle="merge_digest", knobs=("SKYLINE_MERGE_PRUNE",),
+        doc="pruned tournament tree over all live partitions",
+    ),
+    CascadeRow(
+        "merge_flat", "merge", mesh_ok=True, oracle="merge_digest",
+        doc="single O(U²) union pass; the unconditional fallback",
+    ),
+    # -- prune/prefilter gates ---------------------------------------------
+    CascadeRow(
+        "partition_prune", "gate", d_min=3, gate="SKYLINE_MERGE_PRUNE",
+        oracle="prune_identity", knobs=("SKYLINE_MERGE_PRUNE",),
+        doc="O(P²·d) witness prefilter ahead of the tree merge",
+    ),
+    CascadeRow(
+        "chip_prune", "gate", gate="SKYLINE_CHIP_PRUNE", mesh_ok=True,
+        oracle="prune_identity", knobs=("SKYLINE_CHIP_PRUNE",),
+        doc="chip-level witness prefilter in the sharded two-level merge",
+    ),
+    CascadeRow(
+        "host_prune", "gate", gate="SKYLINE_CLUSTER_HOST_PRUNE",
+        mesh_ok=True, oracle="prune_identity",
+        knobs=("SKYLINE_CLUSTER_HOST_PRUNE",),
+        doc="host-level witness prefilter in the cluster merge",
+    ),
+    CascadeRow(
+        "flush_prefilter", "gate", d_min=3,
+        gate="SKYLINE_FLUSH_PREFILTER", oracle="prune_identity",
+        knobs=("SKYLINE_FLUSH_PREFILTER",),
+        doc="quantized grid prefilter ahead of the flush merge",
+    ),
+)
+
+ROW_BY_NAME: dict[str, CascadeRow] = {r.name: r for r in TABLE}
+
+# every knob any row declares tunable — the only names set_override accepts
+TUNABLE_KNOBS: frozenset[str] = frozenset(
+    k for r in TABLE for k in r.knobs
+)
+
+_lock = threading.Lock()
+_overrides: dict[str, str] = {}        # guarded-by: _lock
+_pins: dict[tuple, str] = {}           # guarded-by: _lock
+
+
+def _env_pinned(name: str) -> bool:
+    """True when the operator exported an explicit value for ``name`` —
+    explicit env always beats a tuner override, checked at READ time so
+    a mid-run export wins without a restart."""
+    v = os.environ.get(name)  # lint: allow-raw-env
+    return v is not None and v != ""
+
+
+_BACKEND: str | None = None
+
+
+def _backend() -> str:
+    """Pin-key backend name — the SAME vocabulary as KernelProfiler
+    signatures (``jax.default_backend()``: "cpu"/"tpu"/...), so a pin the
+    tuner learned from profiler rows is found again at resolve time. The
+    row-applicability ``backends`` field keeps its own coarser
+    "tpu"/"host" vocabulary."""
+    global _BACKEND
+    if _BACKEND is None:
+        try:
+            import jax
+
+            _BACKEND = jax.default_backend()
+        except Exception:
+            _BACKEND = "tpu" if on_tpu() else "host"
+    return _BACKEND
+
+
+# -- tuner override surface ------------------------------------------------
+
+def set_override(name: str, value) -> bool:
+    """Install a table-scoped knob override. Refused (False) for knobs no
+    row declares tunable and for env-pinned knobs — the controller can
+    only move levers the table scopes and the operator left floating."""
+    if name not in TUNABLE_KNOBS or _env_pinned(name):
+        return False
+    with _lock:
+        _overrides[name] = str(value)
+    return True
+
+
+def clear_override(name: str) -> None:
+    with _lock:
+        _overrides.pop(name, None)
+
+
+def override(name: str) -> str | None:
+    with _lock:
+        return _overrides.get(name)
+
+
+def overrides_doc() -> dict[str, str]:
+    with _lock:
+        return dict(_overrides)
+
+
+def _eff_bool(name: str | None, legacy: bool) -> bool:
+    if name is None or _env_pinned(name):
+        return legacy
+    ov = override(name)
+    if ov is None:
+        return legacy
+    return ov.strip().lower() in ("1", "true", "on", "yes")
+
+
+def _eff_float(name: str, legacy: float) -> float:
+    if _env_pinned(name):
+        return legacy
+    ov = override(name)
+    if ov is None:
+        return legacy
+    try:
+        return float(ov)
+    except ValueError:
+        return legacy
+
+
+# -- tuner pin surface -----------------------------------------------------
+
+def pin(stage: str, variant: str, d: int, n: int, mp: bool = False,
+        backend: str | None = None) -> bool:
+    """Pin a learned winner for (stage, d, N-bucket, backend, mp). The
+    audit-plane hard rule lives here: only rows with a REGISTERED
+    byte-identity oracle are pinnable; anything else is refused."""
+    row = ROW_BY_NAME.get(variant)
+    if row is None or row.stage != stage:
+        return False
+    if row.oracle not in ORACLES:
+        return False
+    key = (stage, int(d), n_bucket(n), backend or _backend(), bool(mp))
+    with _lock:
+        _pins[key] = variant
+    return True
+
+
+def unpin(stage: str, d: int, n: int, mp: bool = False,
+          backend: str | None = None) -> None:
+    key = (stage, int(d), n_bucket(n), backend or _backend(), bool(mp))
+    with _lock:
+        _pins.pop(key, None)
+
+
+def clear_pins(stage: str | None = None) -> None:
+    with _lock:
+        if stage is None:
+            _pins.clear()
+        else:
+            for k in [k for k in _pins if k[0] == stage]:
+                del _pins[k]
+
+
+def pinned(stage: str, d: int, n: int, mp: bool = False) -> str | None:
+    key = (stage, int(d), n_bucket(n), _backend(), bool(mp))
+    with _lock:
+        return _pins.get(key)
+
+
+def pins_doc() -> list[dict]:
+    with _lock:
+        items = list(_pins.items())
+    return [
+        {"stage": k[0], "d": k[1], "n_bucket": k[2], "backend": k[3],
+         "mp": k[4], "variant": v}
+        for k, v in sorted(items)
+    ]
+
+
+# -- stage resolution (the five legacy dispatch sites) ---------------------
+
+def resolve_mask(d: int, n: int, concrete: bool, profiler,
+                 mp: bool = False) -> tuple[str, bool]:
+    """The mask-stage row for one ``skyline_mask_auto`` call. Returns
+    ``(variant, record)`` — ``record`` reproduces the legacy recording
+    discipline exactly: auto races over concrete arrays (and the forced
+    host cascade) record under the chooser profiler, forced device paths
+    and traced calls do not."""
+    if d <= 2:
+        return "mask_sweep", False
+    dc = device_cascade_mode()
+    if on_tpu():
+        dev = "mask_rank_pallas" if gate("mask_rank_pallas") else "mask_pallas"
+        if dc == "off":
+            return dev, False
+        if dc == "on":
+            return "mask_device_cascade", False
+        if concrete:
+            p = pinned("mask", d, n, mp)
+            if p in (dev, "mask_device_cascade"):
+                return p, True
+            return (
+                choose_variant(
+                    profiler, (dev, "mask_device_cascade"), d, n, mp
+                ),
+                True,
+            )
+        # traced: nothing can record under a tracer, so the cascade only
+        # swaps in on existing measured evidence for BOTH candidates
+        if profiler is not None:
+            e_dev = profiler.ema_ms(dev, d, n, mp)
+            e_dc = profiler.ema_ms("mask_device_cascade", d, n, mp)
+            if e_dev is not None and e_dc is not None and e_dc < e_dev:
+                return "mask_device_cascade", False
+        return dev, False
+    mode = sorted_sfs_mode()
+    if not concrete:
+        if dc == "on":
+            return "mask_device_cascade", False
+        return "mask_scan", False
+    if mode == "on":
+        return "sorted_sfs_mask", True
+    if mode != "off" and dc == "off":
+        # the historical two-way host race (pre-device-cascade)
+        cands = ("sorted_sfs_mask", "mask_scan")
+        p = pinned("mask", d, n)
+        if p in cands:
+            return p, True
+        return choose_variant(profiler, cands, d, n), True
+    if dc == "on":
+        return "mask_device_cascade", False
+    if mode == "off" and dc == "off":
+        return "mask_scan", False
+    cands = ()
+    if mode != "off":
+        cands += ("sorted_sfs_mask",)
+    cands += ("mask_scan", "mask_device_cascade")
+    p = pinned("mask", d, n)
+    if p in cands:
+        return p, True
+    return choose_variant(profiler, cands, d, n), True
+
+
+def flush_chooser_active(meshed: bool) -> bool:
+    """Whether any alternative flush row is in play for this set — the
+    condition under which the caller must own a chooser profiler before
+    calling :func:`resolve_flush` (legacy lazy-creation contract)."""
+    if meshed:
+        return False
+    mode = "off" if on_tpu() else sorted_sfs_mode()
+    return not (mode == "off" and device_cascade_mode() == "off")
+
+
+def resolve_flush(device_variant: str, d: int, total_rows: int,
+                  meshed: bool, profiler) -> str:
+    """The flush-stage path for one lazy flush: ``"sorted_sfs"``,
+    ``"device_cascade"``, or the device SFS ``device_variant``. The
+    device cascade joins the race only when the host cascade is OUT of
+    play (TPU or sorted=off) — the PR 18 scoping that keeps fresh host
+    engines from paying a losing exploration flush."""
+    if meshed:
+        return device_variant
+    mode = "off" if on_tpu() else sorted_sfs_mode()
+    dc = device_cascade_mode()
+    if mode == "off" and dc == "off":
+        return device_variant
+    if mode == "on":
+        return "sorted_sfs"
+    if dc == "on":
+        return "device_cascade"
+    cands = []
+    if mode != "off":
+        cands.append("flush_sorted_sfs")
+    cands.append("flush_sfs_" + device_variant)
+    if dc != "off" and mode == "off":
+        cands.append("flush_device_cascade")
+    p = pinned("flush", d, total_rows)
+    if p in cands:
+        chosen = p
+    else:
+        chosen = choose_variant(profiler, tuple(cands), d, total_rows)
+    if chosen == "flush_sorted_sfs":
+        return "sorted_sfs"
+    if chosen == "flush_device_cascade":
+        return "device_cascade"
+    return device_variant
+
+
+def merge_cache_on(meshed: bool) -> bool:
+    """Cache-row applicability for this merge (meshed sets never cache)."""
+    return (not meshed) and _eff_bool(
+        "SKYLINE_MERGE_CACHE", merge_cache_enabled()
+    )
+
+
+def delta_cutoff() -> float:
+    """Effective delta-merge dirty-fraction cutoff (tuner-movable)."""
+    return _eff_float("SKYLINE_DELTA_CUTOFF", delta_dirty_cutoff())
+
+
+def delta_applies(dirty_fraction: float) -> bool:
+    return 0.0 < dirty_fraction <= delta_cutoff()
+
+
+def merge_tree_on(meshed: bool, d: int) -> bool:
+    return (not meshed) and d > 2 and _eff_bool(
+        "SKYLINE_MERGE_TREE", merge_tree_enabled()
+    )
+
+
+def merge_path(use_tree: bool, delta: bool) -> str:
+    """The merge-stage row name for one launch (cache_hit handled by the
+    caller before any kernel work)."""
+    return ("tree_delta" if delta and use_tree
+            else "delta" if delta
+            else "tree" if use_tree else "flat")
+
+
+_GATE_LEGACY = {
+    "mask_rank_pallas": rank_cascade,
+    "partition_prune": merge_prune_enabled,
+    "chip_prune": chip_prune_enabled,
+    "host_prune": host_prune_enabled,
+    "flush_prefilter": flush_prefilter_enabled,
+}
+
+
+def gate(name: str) -> bool:
+    """Effective state of a boolean gate row (legacy env knob, then the
+    tuner override when the env left it floating)."""
+    row = ROW_BY_NAME[name]
+    return _eff_bool(row.gate, _GATE_LEGACY[name]())
+
+
+def applies(name: str, d: int | None = None, meshed: bool = False) -> bool:
+    """Gate state AND the row's declared applicability — the one-call
+    form for sites that used to inline ``mesh is None and dims > 2 and
+    <knob>()`` (e.g. the flush grid prefilter)."""
+    row = ROW_BY_NAME[name]
+    if meshed and not row.mesh_ok:
+        return False
+    if d is not None:
+        if d < row.d_min:
+            return False
+        if row.d_max is not None and d > row.d_max:
+            return False
+    return gate(name)
+
+
+def table_doc() -> dict:
+    """The ``GET /dispatch`` table block: every row with its declared
+    applicability, the oracle registry, and the live tuner surfaces."""
+    rows = []
+    for r in TABLE:
+        rows.append({
+            "name": r.name,
+            "stage": r.stage,
+            "backends": list(r.backends),
+            "d_min": r.d_min,
+            "d_max": r.d_max,
+            "traced_ok": r.traced_ok,
+            "mesh_ok": r.mesh_ok,
+            "gate": r.gate,
+            "oracle": r.oracle,
+            "knobs": list(r.knobs),
+            "doc": r.doc,
+        })
+    return {
+        "backend": _backend(),
+        "rows": rows,
+        "oracles": dict(ORACLES),
+        "pins": pins_doc(),
+        "overrides": overrides_doc(),
+        "effective": {
+            "merge_cache": merge_cache_on(False),
+            "merge_tree_d4": merge_tree_on(False, 4),
+            "delta_cutoff": delta_cutoff(),
+            "partition_prune": gate("partition_prune"),
+            "chip_prune": gate("chip_prune"),
+            "host_prune": gate("host_prune"),
+            "flush_prefilter": gate("flush_prefilter"),
+        },
+    }

@@ -339,6 +339,23 @@ def workload_enabled() -> bool:
     return env_bool("SKYLINE_WORKLOAD", True)
 
 
+def tuner_enabled() -> bool:
+    """``SKYLINE_TUNER`` gates the closed-loop dispatch tuner
+    (``telemetry/tuner.py``): an online controller consuming the
+    WorkloadCharacterizer regime + drift events, KernelProfiler EMAs, and
+    SLO burn, and retuning cascade-table pins/knobs per (regime,
+    signature) with bounded per-epoch moves. Safe by construction — it
+    may only select table rows whose byte-identity oracle is registered
+    (``ops/cascade.py``), explicit env knobs always beat its overrides,
+    and it stays passive until a workload epoch closes AND
+    ``SKYLINE_TUNER_EPOCH_S`` elapses — so default ON; set ``0`` for the
+    static-dispatch baseline (``benchmarks/tuner.py`` A/B). Read lazily
+    at engine construction."""
+    from skyline_tpu.analysis.registry import env_bool
+
+    return env_bool("SKYLINE_TUNER", True)
+
+
 def profile_cost_enabled() -> bool:
     """``SKYLINE_PROFILE_COST`` additionally captures XLA
     ``cost_analysis()`` FLOPs/bytes per dispatch signature via a one-shot
@@ -458,7 +475,15 @@ def _is_concrete(x) -> bool:
 
 
 def skyline_mask_auto(x, valid=None):
-    """Survivor mask with the fastest kernel for the active backend."""
+    """Survivor mask with the fastest kernel for the active backend.
+
+    The variant decision lives in the declarative cascade table
+    (``ops/cascade.py resolve_mask`` — env modes force/exclude first,
+    ``auto`` races measured EMAs, traced calls swap only on evidence,
+    tuner pins short-circuit the race); this function only EXECUTES the
+    chosen row, with the historical recording discipline (auto races
+    over concrete arrays sync + record for honest EMA walls, forced
+    device paths and traced calls dispatch bare)."""
     if x.shape[1] <= 2:
         # d <= 2 needs no pairwise work at all: sort + prefix-min sweep
         # (ops/sweep2d.py), O(n log n) on every backend — at the 262k-row
@@ -466,110 +491,42 @@ def skyline_mask_auto(x, valid=None):
         from skyline_tpu.ops.sweep2d import skyline_mask_sweep
 
         return skyline_mask_sweep(x, valid)
-    dc_mode = device_cascade_mode()
-    if on_tpu():
+    from skyline_tpu.ops import cascade
+
+    n, d = x.shape
+    concrete = _is_concrete(x) and (valid is None or _is_concrete(valid))
+    # mp only keys TPU signatures (the host races always recorded under
+    # mp=False, even with SKYLINE_MIXED_PRECISION exported)
+    mp = mixed_precision_enabled() if on_tpu() else False
+    prof = _mask_profiler()
+    variant, rec = cascade.resolve_mask(d, n, concrete, prof, mp=mp)
+
+    if variant in ("mask_pallas", "mask_rank_pallas"):
         from skyline_tpu.ops.pallas_dominance import (
             skyline_mask_pallas,
             skyline_mask_rank_pallas,
         )
 
-        def _pallas_mask(x, valid):
-            if rank_cascade():
-                return skyline_mask_rank_pallas(x, valid)
-            return skyline_mask_pallas(x, valid)
-
-        if dc_mode == "off":
-            return _pallas_mask(x, valid)
-        from skyline_tpu.ops.device_cascade import device_cascade_mask
-
-        if dc_mode == "on":
-            return device_cascade_mask(x, valid)
-        # auto: quadratic Pallas tiles vs the device cascade, per
-        # (variant, d, N-bucket, backend, mp) signature. Concrete calls
-        # explore + record (synced for honest walls); traced call sites
-        # cannot record, so they only swap the cascade in once BOTH
-        # candidates carry measured evidence and the cascade wins.
-        n, d = x.shape
-        prof = _mask_profiler()
-        mp = mixed_precision_enabled()
-        device_variant = (
-            "mask_rank_pallas" if rank_cascade() else "mask_pallas"
+        kern = (
+            skyline_mask_rank_pallas
+            if variant == "mask_rank_pallas"
+            else skyline_mask_pallas
         )
-        if _is_concrete(x) and (valid is None or _is_concrete(valid)):
-            variant = choose_variant(
-                prof, (device_variant, "mask_device_cascade"), d, n, mp
-            )
-            if variant == "mask_device_cascade":
-                with prof.record("mask_device_cascade", d, n, mp):
-                    out = device_cascade_mask(x, valid)
-                    out.block_until_ready()
-                return out
-            with prof.record(device_variant, d, n, mp):
-                out = _pallas_mask(x, valid)
-                out.block_until_ready()  # honest wall for the EMA compare
-            return out
-        e_dev = prof.ema_ms(device_variant, d, n, mp)
-        e_dc = prof.ema_ms("mask_device_cascade", d, n, mp)
-        if e_dev is not None and e_dc is not None and e_dc < e_dev:
-            return device_cascade_mask(x, valid)
-        return _pallas_mask(x, valid)
-    from skyline_tpu.ops.block_skyline import skyline_mask_scan
-
-    # d > 2 off-TPU: sorted-order SFS host cascade vs the scan kernel vs
-    # the device cascade, chosen per (d, N, backend) from measured
-    # profiler wall data. The host cascade only applies to concrete
-    # arrays — under tracing (jit bodies, the jaxpr audit) the traced
-    # candidates are the scan kernel and (when forced on) the device
-    # cascade, which is pure lax over static shapes.
-    mode = sorted_sfs_mode()
-    concrete = _is_concrete(x) and (valid is None or _is_concrete(valid))
-    if not concrete:
-        if dc_mode == "on":
-            from skyline_tpu.ops.device_cascade import device_cascade_mask
-
-            return device_cascade_mask(x, valid)
-        return skyline_mask_scan(x, valid)
-    if mode == "on" or (mode != "off" and dc_mode == "off"):
-        # forced host cascade, or the historical two-way host race
-        import jax.numpy as jnp
-        import numpy as np
-
-        from skyline_tpu.ops.sorted_sfs import sorted_skyline_mask_np
-
-        n, d = x.shape
-        prof = _mask_profiler()
-        if mode == "on":
-            variant = "sorted_sfs_mask"
-        else:
-            variant = choose_variant(
-                prof, ("sorted_sfs_mask", "mask_scan"), d, n
-            )
-        if variant == "sorted_sfs_mask":
-            with prof.record("sorted_sfs_mask", d, n):
-                mask = sorted_skyline_mask_np(
-                    np.asarray(x),
-                    None if valid is None else np.asarray(valid),
-                )
-                out = jnp.asarray(mask)
-            return out
-        with prof.record("mask_scan", d, n):
-            out = skyline_mask_scan(x, valid)
+        if not rec:
+            return kern(x, valid)
+        with prof.record(variant, d, n, mp):
+            out = kern(x, valid)
             out.block_until_ready()  # honest wall for the EMA compare
         return out
-    from skyline_tpu.ops.device_cascade import device_cascade_mask
+    if variant == "mask_device_cascade":
+        from skyline_tpu.ops.device_cascade import device_cascade_mask
 
-    if dc_mode == "on":
-        return device_cascade_mask(x, valid)
-    if mode == "off" and dc_mode == "off":
-        return skyline_mask_scan(x, valid)
-    candidates = []
-    if mode != "off":
-        candidates.append("sorted_sfs_mask")
-    candidates.append("mask_scan")
-    candidates.append("mask_device_cascade")
-    n, d = x.shape
-    prof = _mask_profiler()
-    variant = choose_variant(prof, tuple(candidates), d, n)
+        if not rec:
+            return device_cascade_mask(x, valid)
+        with prof.record("mask_device_cascade", d, n, mp):
+            out = device_cascade_mask(x, valid)
+            out.block_until_ready()
+        return out
     if variant == "sorted_sfs_mask":
         import jax.numpy as jnp
         import numpy as np
@@ -584,11 +541,10 @@ def skyline_mask_auto(x, valid=None):
                 )
             )
         return out
-    if variant == "mask_device_cascade":
-        with prof.record("mask_device_cascade", d, n):
-            out = device_cascade_mask(x, valid)
-            out.block_until_ready()
-        return out
+    from skyline_tpu.ops.block_skyline import skyline_mask_scan
+
+    if not rec:
+        return skyline_mask_scan(x, valid)
     with prof.record("mask_scan", d, n):
         out = skyline_mask_scan(x, valid)
         out.block_until_ready()  # honest wall for the EMA compare

@@ -546,6 +546,8 @@ class SkylineServer:
             await self._explain(writer, params)
         elif path == "/audit" and method == "GET":
             await self._audit(writer, params)
+        elif path == "/dispatch" and method == "GET":
+            await self._dispatch(writer)
         elif path == "/fleet" and method == "GET":
             await self._fleet(writer)
         elif path == "/health" and method == "GET":
@@ -855,6 +857,14 @@ class SkylineServer:
         except Exception:
             stats = {}
         await self._reply(writer, 200, fleet_doc(self.telemetry, stats))
+
+    async def _dispatch(self, writer):
+        """The declarative cascade table + live tuner decisions (ISSUE
+        20): every dispatch row's applicability/oracle, the active pins
+        and knob overrides, and the controller's recent moves."""
+        from skyline_tpu.telemetry.tuner import dispatch_doc
+
+        await self._reply(writer, 200, dispatch_doc(self.telemetry))
 
     async def _health(self, writer):
         """The /health chip block (RUNBOOK §2p): per-chip health scores +

@@ -35,19 +35,12 @@ import numpy as np
 
 from skyline_tpu.metrics.tracing import NULL_TRACER
 from skyline_tpu.resilience.faults import fault_point
+from skyline_tpu.ops import cascade
 from skyline_tpu.ops.dispatch import (
-    choose_variant,
-    delta_dirty_cutoff,
-    device_cascade_mode,
-    flush_prefilter_enabled,
     flush_stage_depth,
-    merge_cache_enabled,
-    merge_prune_enabled,
-    merge_tree_enabled,
     mixed_precision_enabled,
     on_tpu,
     profile_cost_enabled,
-    sorted_sfs_mode,
 )
 from skyline_tpu.stream.window import (
     DEFAULT_BUFFER_SIZE,
@@ -1115,9 +1108,9 @@ class PartitionSet:
     def _prefilter_on(self) -> bool:
         """Grid prefilter liveness for this set: single device, ``dims >
         2`` (the d <= 2 sweep flush has no merge kernels to save), gate
-        env read per flush."""
-        return (
-            self.mesh is None and self.dims > 2 and flush_prefilter_enabled()
+        resolved through the cascade table per flush."""
+        return cascade.applies(
+            "flush_prefilter", d=self.dims, meshed=self.mesh is not None
         )
 
     def _maybe_launch_grid(self) -> None:
@@ -1347,35 +1340,20 @@ class PartitionSet:
         cascade loses to it at every measured signature, so listing it
         would make every fresh engine pay a losing exploration flush for
         nothing (``SKYLINE_DEVICE_CASCADE=on`` still forces it anywhere
-        for A/B). Meshed flushes stay on the shard_map SFS rounds."""
-        if self.mesh is not None:
+        for A/B). Meshed flushes stay on the shard_map SFS rounds. The
+        candidate set and race now resolve through the declarative
+        cascade table (``ops/cascade.py resolve_flush``), which also
+        honors tuner-pinned winners for this (d, N-bucket) signature."""
+        meshed = self.mesh is not None
+        if meshed:
             return device_variant
-        mode = sorted_sfs_mode() if not on_tpu() else "off"
-        dc_mode = device_cascade_mode()
-        if mode == "off" and dc_mode == "off":
-            return device_variant
-        if self._flush_prof is None:
+        if cascade.flush_chooser_active(meshed) and self._flush_prof is None:
             from skyline_tpu.telemetry.profiler import KernelProfiler
 
             self._flush_prof = KernelProfiler()
-        if mode == "on":
-            return "sorted_sfs"
-        if dc_mode == "on":
-            return "device_cascade"
-        candidates = []
-        if mode != "off":
-            candidates.append("flush_sorted_sfs")
-        candidates.append("flush_sfs_" + device_variant)
-        if dc_mode != "off" and mode == "off":
-            candidates.append("flush_device_cascade")
-        chosen = choose_variant(
-            self._flush_prof, tuple(candidates), self.dims, total_rows
+        return cascade.resolve_flush(
+            device_variant, self.dims, total_rows, meshed, self._flush_prof
         )
-        if chosen == "flush_sorted_sfs":
-            return "sorted_sfs"
-        if chosen == "flush_device_cascade":
-            return "device_cascade"
-        return device_variant
 
     def _sfs_sorted_host(self, rows: list[np.ndarray]):
         """Host sorted-order SFS flush: per partition, take the exact
@@ -1861,7 +1839,7 @@ class PartitionSet:
         # claim the parked EXPLAIN plan (one-shot): it rides the handle so
         # an overlapped merge annotates the query that launched it
         h.explain, self._explain = self._explain, None
-        use_cache = merge_cache_enabled() and self.mesh is None
+        use_cache = cascade.merge_cache_on(self.mesh is not None)
         h.use_cache = use_cache
         cache = self._gm_cache if use_cache else None
         if cache is not None and cache["key"] == h.key:
@@ -1901,17 +1879,12 @@ class PartitionSet:
         if cache is not None:
             dirty_mask = self._epoch != cache["epoch"]
             self.last_dirty_fraction = float(dirty_mask.sum()) / P
-            cutoff = delta_dirty_cutoff()
-            if 0.0 < self.last_dirty_fraction <= cutoff:
+            if cascade.delta_applies(self.last_dirty_fraction):
                 dirty = dirty_mask
         elif use_cache:
             self.last_dirty_fraction = 1.0  # cold miss == everything dirty
-        use_tree = (
-            self.mesh is None and self.dims > 2 and merge_tree_enabled()
-        )
-        path = ("tree_delta" if dirty is not None and use_tree
-                else "delta" if dirty is not None
-                else "tree" if use_tree else "flat")
+        use_tree = cascade.merge_tree_on(self.mesh is not None, self.dims)
+        path = cascade.merge_path(use_tree, dirty is not None)
         self._fnote(
             "merge.launch", path=path, dirty_fraction=self.last_dirty_fraction,
         )
@@ -2080,12 +2053,9 @@ class PartitionSet:
         (async, tiny) so the next merge's prefilter reads landed bytes
         instead of launching cold. Only when the tree + prefilter are both
         live for this set (``dims > 2``, single device)."""
-        if (
-            self.mesh is None
-            and self.dims > 2
-            and merge_tree_enabled()
-            and merge_prune_enabled()
-        ):
+        if cascade.merge_tree_on(
+            self.mesh is not None, self.dims
+        ) and cascade.gate("partition_prune"):
             self._launch_summaries()
 
     def _launch_summaries(self) -> None:
@@ -2129,7 +2099,7 @@ class PartitionSet:
         alive = self._count_ub > 0
         considered = int(alive.sum())
         npruned = 0
-        if merge_prune_enabled() and considered > 1:
+        if cascade.gate("partition_prune") and considered > 1:
             pruned = self._prune_mask(alive)
             npruned = int(pruned.sum())
             leaf_mask = alive & ~pruned

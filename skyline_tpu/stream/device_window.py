@@ -137,9 +137,10 @@ def rank_flush_enabled() -> bool:
     """Rank-cascade SFS flush: enabled when the rank kernels can run (TPU,
     or interpret mode for tests) and ``SKYLINE_RANK_CASCADE`` is not 0.
     Read lazily at trace/flush time."""
-    from skyline_tpu.ops.dispatch import on_tpu, rank_cascade
+    from skyline_tpu.ops import cascade
+    from skyline_tpu.ops.dispatch import on_tpu
 
-    return rank_cascade() and (on_tpu() or pallas_interpret())
+    return cascade.gate("mask_rank_pallas") and (on_tpu() or pallas_interpret())
 
 
 @functools.partial(

@@ -295,6 +295,28 @@ class SkylineEngine:
             )
             if telemetry is not None:
                 telemetry.workload = self.workload
+        # dispatch-tuner plane (ISSUE 20): the closed-loop controller
+        # over the declarative cascade table — consumes the workload
+        # regime, profiler EMAs, and SLO burn; retunes table pins/knobs
+        # with bounded per-epoch moves. Ticked from the query path (cheap
+        # cadence check) and the worker idle loop; passive until a
+        # workload epoch closes, so bytes and unit-scale behavior are
+        # untouched by default.
+        self.tuner = None
+        from skyline_tpu.ops.dispatch import tuner_enabled
+
+        if telemetry is not None and tuner_enabled():
+            from skyline_tpu.telemetry.tuner import DispatchTuner
+
+            self.tuner = DispatchTuner(
+                telemetry=telemetry,
+                workload=self.workload,
+                profiler=self.profiler,
+                flush_profiler=lambda: getattr(
+                    self.pset, "_flush_prof", None
+                ),
+            )
+            telemetry.tuner = self.tuner
 
     def attach_snapshots(self, store) -> None:
         """Publish completed global skylines to ``store`` (a
@@ -823,6 +845,11 @@ class SkylineEngine:
                 # the regime this answer was computed under — joins the
                 # drift trajectory to individual answers in /explain
                 plan.workload = self.workload.regime()
+            if self.tuner is not None:
+                # one cadence-gated controller epoch per query window,
+                # then the dispatch context this answer ran under
+                self.tuner.maybe_tune()
+                plan.tuner = self.tuner.explain_block()
             self.telemetry.explain.add(plan.to_doc())
             self.telemetry.inc("explain.records")
             if q.span_t0_ns:

@@ -84,6 +84,11 @@ class Telemetry:
         # block, and the skyline_chip_*{chip=...} metric families
         self.fleet = None
         self.workload = None
+        # dispatch-tuner plane (ISSUE 20): the closed-loop controller
+        # over the cascade table (``telemetry/tuner.py``), attached by
+        # the engine when SKYLINE_TUNER is on; both HTTP surfaces serve
+        # GET /dispatch (table + tuner decisions) through this slot
+        self.tuner = None
         # chip-health plane (RUNBOOK §2p): attached by the sharded engine
         # (None on flat workers); serves the /health chip block and the
         # quarantine state on /fleet

@@ -49,7 +49,7 @@ class QueryPlan:
 
     __slots__ = (
         "trace_id", "query_id", "merge", "tree", "chips", "hosts", "cascade",
-        "kernels", "publish", "timing", "workload",
+        "kernels", "publish", "timing", "workload", "tuner",
     )
 
     def __init__(self, trace_id: str | None, query_id: str):
@@ -64,6 +64,7 @@ class QueryPlan:
         self.publish: dict | None = None
         self.timing: dict | None = None
         self.workload: dict | None = None  # regime tag (telemetry/workload.py)
+        self.tuner: dict | None = None  # dispatch-tuner context (ISSUE 20)
 
     def to_doc(self) -> dict:
         """Freeze into the JSON-serializable record the ring stores."""
@@ -80,6 +81,7 @@ class QueryPlan:
             "publish": self.publish,
             "timing": self.timing,
             "workload": self.workload,
+            "tuner": self.tuner,
         }
 
 
@@ -267,6 +269,14 @@ def format_plan(doc: dict) -> str:
         lines.append(
             f"  workload kind={w.get('kind')} rho={w.get('rho')}"
             f" epoch={w.get('epoch')} drift_total={w.get('drift_total')}"
+        )
+    t = doc.get("tuner")
+    if t is not None:
+        last = t.get("last") or {}
+        lines.append(
+            f"  tuner regime={t.get('regime')} pins={t.get('pins')}"
+            f" moves={t.get('moves')}"
+            + (f" last={last.get('action')}" if last else "")
         )
     p = doc.get("publish")
     if p is not None:

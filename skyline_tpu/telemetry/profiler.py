@@ -190,6 +190,92 @@ class KernelProfiler:
         with self._lock:
             return sum(e.wall_ms for e in self._entries.values())
 
+    def export_state(self) -> dict:
+        """JSON-safe snapshot of every signature's accumulators — the
+        checkpoint payload that lets a restarted worker keep its learned
+        dispatch (a restored EMA means ``claim_explore`` never re-runs a
+        losing variant's cold path: the PR 18 cold-boot regression).
+        In-flight exploration claims are deliberately NOT exported — a
+        claim whose record never landed must not survive a restart, or
+        the candidate would stay unexplored forever."""
+        with self._lock:
+            entries = [
+                {
+                    "variant": k[0], "d": k[1], "n_bucket": k[2],
+                    "backend": k[3], "mp": k[4],
+                    "calls": e.calls,
+                    "wall_ms": round(e.wall_ms, 6),
+                    "ema_ms": round(e.ema_ms, 6),
+                    "first_call_ms": (
+                        None if e.first_call_ms is None
+                        else round(e.first_call_ms, 6)
+                    ),
+                    "last_ms": round(e.last_ms, 6),
+                }
+                for k, e in self._entries.items()
+            ]
+        return {"version": 1, "entries": entries}
+
+    def restore_state(self, doc) -> int:
+        """Adopt signatures from an ``export_state`` document. LIVE data
+        wins: a signature this process already measured is left alone
+        (fresher than anything a checkpoint carries). Returns the number
+        of signatures adopted. Malformed rows are skipped — a corrupt
+        checkpoint extra must not take the profiler down."""
+        if not isinstance(doc, dict):
+            return 0
+        adopted = 0
+        for row in doc.get("entries") or []:
+            try:
+                key = (
+                    str(row["variant"]), int(row["d"]),
+                    int(row["n_bucket"]), str(row["backend"]),
+                    bool(row["mp"]),
+                )
+                calls = int(row["calls"])
+                wall = float(row["wall_ms"])
+                ema = float(row["ema_ms"])
+                first = row.get("first_call_ms")
+                first = None if first is None else float(first)
+                last = float(row.get("last_ms", 0.0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if calls <= 0:
+                continue
+            with self._lock:
+                if key in self._entries:
+                    continue
+                e = self._entries[key] = _Entry()
+                e.calls = calls
+                e.wall_ms = wall
+                e.ema_ms = ema
+                e.first_call_ms = first
+                e.last_ms = last
+                self._claimed.discard(key)
+            adopted += 1
+        return adopted
+
+    def reset_signatures(self, variants=None) -> int:
+        """Drop measured entries (and claims) for the given variant names
+        — or every signature when ``variants`` is None — so the next
+        ``choose_variant`` race re-explores from scratch. The dispatch
+        tuner calls this on a confirmed workload-regime flip: EMAs
+        measured under the old regime are evidence about the wrong
+        distribution. Returns the number of signatures dropped."""
+        names = None if variants is None else set(variants)
+        with self._lock:
+            keys = [
+                k for k in self._entries
+                if names is None or k[0] in names
+            ]
+            for k in keys:
+                del self._entries[k]
+            self._claimed = {
+                k for k in self._claimed
+                if names is not None and k[0] not in names
+            }
+        return len(keys)
+
     def snapshot_counts(self) -> dict[tuple, tuple[int, float]]:
         """{signature: (calls, wall_ms)} — the cheap mark the EXPLAIN
         plane diffs around one query window to attribute dispatches."""

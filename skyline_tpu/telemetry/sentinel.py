@@ -101,6 +101,15 @@ DEFAULT_RULES = (
     {"label": "cluster.replication_lag_p99_ms",
      "path": ["cluster", "replication_lag_p99_ms"], "higher_is_better": False,
      "threshold": 2.0},
+    # dispatch-tuner plane (ISSUE 20): hindsight regret of the closed-loop
+    # controller vs the best static dispatch under drift. Negative when
+    # adapting pays; a sustained climb means the controller is burning
+    # exploration it never earns back. Wall-clock A/B on the CPU fallback
+    # is noisy (and the baseline can sit near zero), so only a blowup
+    # trips — the sign-safe delta here divides by |median|.
+    {"label": "tuner.regret_fraction",
+     "path": ["tuner", "regret_fraction"], "higher_is_better": False,
+     "threshold": 2.0},
 )
 
 

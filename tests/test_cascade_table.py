@@ -1,0 +1,686 @@
+"""The declarative dispatch cascade table + closed-loop tuner (ISSUE 20).
+
+Three properties pin the refactor:
+
+1. **Decision parity** — ``cascade.resolve_mask`` / ``resolve_flush`` /
+   the merge helpers reproduce the pre-refactor env-gated decisions
+   exactly, over the (mode x cascade x concrete) grid, including the
+   fresh-profiler exploration order and the EMA-decided steady state.
+2. **Byte identity** — every mask-stage row the table can select
+   produces the identical survivor mask on the same input (the oracle
+   claim the tuner's pin rule rests on).
+3. **Controller safety** — pins only land on oracle-registered rows and
+   only inside the legal candidate set; explicit env always beats an
+   override; moves are bounded, hysteresis gates regime switches, SLO
+   burn reverts; learned state survives the checkpoint round-trip.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from conftest import assert_same_set, gen_points, host_oracle
+from skyline_tpu.ops import cascade
+from skyline_tpu.telemetry import Telemetry
+from skyline_tpu.telemetry.profiler import (
+    FlightRecorder,
+    KernelProfiler,
+    n_bucket,
+)
+from skyline_tpu.telemetry.tuner import (
+    STAGE_VARIANTS,
+    DispatchTuner,
+    dispatch_doc,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_table(monkeypatch):
+    """Pins/overrides are process-global table state; every test starts
+    and ends with a clean table and floating dispatch knobs."""
+    for name in (
+        "SKYLINE_SORTED_SFS", "SKYLINE_DEVICE_CASCADE",
+        "SKYLINE_RANK_CASCADE", "SKYLINE_DELTA_CUTOFF",
+        "SKYLINE_MERGE_PRUNE", "SKYLINE_MERGE_CACHE",
+        "SKYLINE_MERGE_TREE", "SKYLINE_FLUSH_PREFILTER",
+    ):
+        monkeypatch.delenv(name, raising=False)
+    cascade.clear_pins()
+    for k in cascade.TUNABLE_KNOBS:
+        cascade.clear_override(k)
+    yield
+    cascade.clear_pins()
+    for k in cascade.TUNABLE_KNOBS:
+        cascade.clear_override(k)
+
+
+def _prof(emas=None, backend="cpu"):
+    """A profiler with injected EMA state (restore_state is the same
+    entry point the checkpoint plane uses)."""
+    p = KernelProfiler(backend=backend)
+    if emas:
+        p.restore_state({
+            "version": 1,
+            "entries": [
+                {
+                    "variant": v, "d": d, "n_bucket": nb,
+                    "backend": backend, "mp": False, "calls": 3,
+                    "wall_ms": e * 3, "ema_ms": e,
+                    "first_call_ms": e, "last_ms": e,
+                }
+                for (v, d, nb), e in emas.items()
+            ],
+        })
+    return p
+
+
+# --------------------------------------------------------------------------
+# table integrity
+# --------------------------------------------------------------------------
+
+
+def test_table_shape_and_oracles():
+    assert len(cascade.TABLE) >= 19
+    stages = {r.stage for r in cascade.TABLE}
+    assert stages == {"mask", "flush", "merge", "gate"}
+    for r in cascade.TABLE:
+        # every row is either oracle-backed or explicitly unpinnable
+        assert r.oracle is None or r.oracle in cascade.ORACLES
+        assert cascade.ROW_BY_NAME[r.name] is r
+    # the tunable-knob union is exactly what rows declare
+    declared = {k for r in cascade.TABLE for k in r.knobs}
+    assert cascade.TUNABLE_KNOBS == frozenset(declared)
+
+
+def test_tunable_knobs_are_registered():
+    from skyline_tpu.analysis.registry import KNOBS
+
+    names = {k.name for k in KNOBS}
+    for k in cascade.TUNABLE_KNOBS:
+        assert k in names
+
+
+def test_table_doc_is_json_safe():
+    doc = cascade.table_doc()
+    json.dumps(doc)
+    assert len(doc["rows"]) == len(cascade.TABLE)
+    assert doc["oracles"] == cascade.ORACLES
+    assert "effective" in doc
+
+
+# --------------------------------------------------------------------------
+# 1. decision parity: the hand-ported legacy grid (host backend)
+# --------------------------------------------------------------------------
+
+# (sorted_sfs_mode, device_cascade_mode, concrete) -> (variant, record)
+# with a FRESH profiler: the auto race explores the first-listed
+# candidate (sticky claim), exactly the legacy choose_variant order.
+_HOST_GRID = [
+    ("off", "off", True, "mask_scan", False),
+    ("off", "off", False, "mask_scan", False),
+    ("on", "off", True, "sorted_sfs_mask", True),
+    ("on", "auto", True, "sorted_sfs_mask", True),
+    ("on", "off", False, "mask_scan", False),  # traced: host row illegal
+    ("auto", "off", True, "sorted_sfs_mask", True),
+    ("auto", "auto", True, "sorted_sfs_mask", True),
+    ("off", "auto", True, "mask_scan", True),
+    ("off", "on", True, "mask_device_cascade", False),
+    ("auto", "on", True, "mask_device_cascade", False),
+    ("auto", "off", False, "mask_scan", False),
+    ("auto", "on", False, "mask_device_cascade", False),
+]
+
+
+@pytest.mark.parametrize("mode,dc,concrete,variant,record", _HOST_GRID)
+def test_resolve_mask_legacy_grid(monkeypatch, mode, dc, concrete,
+                                  variant, record):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", mode)
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", dc)
+    got = cascade.resolve_mask(4, 512, concrete, _prof())
+    assert got == (variant, record), (mode, dc, concrete)
+
+
+@pytest.mark.parametrize("d", [1, 2])
+def test_resolve_mask_low_d_is_sweep(d):
+    assert cascade.resolve_mask(d, 100, True, _prof()) == ("mask_sweep", False)
+
+
+def test_resolve_mask_ema_decides(monkeypatch):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "auto")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "off")
+    fast_scan = _prof({
+        ("sorted_sfs_mask", 4, 512): 5.0, ("mask_scan", 4, 512): 1.0,
+    })
+    assert cascade.resolve_mask(4, 512, True, fast_scan)[0] == "mask_scan"
+    fast_sorted = _prof({
+        ("sorted_sfs_mask", 4, 512): 1.0, ("mask_scan", 4, 512): 5.0,
+    })
+    assert (
+        cascade.resolve_mask(4, 512, True, fast_sorted)[0]
+        == "sorted_sfs_mask"
+    )
+
+
+def test_resolve_mask_pin_short_circuits_within_candidates(monkeypatch):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "auto")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "auto")
+    prof = _prof({
+        ("sorted_sfs_mask", 4, 512): 1.0, ("mask_scan", 4, 512): 5.0,
+        ("mask_device_cascade", 4, 512): 5.0,
+    })
+    assert cascade.pin("mask", "mask_device_cascade", 4, 512)
+    # the pin wins over the EMA race (it IS a legal candidate here)
+    assert (
+        cascade.resolve_mask(4, 512, True, prof)
+        == ("mask_device_cascade", True)
+    )
+    # ...but a pin naming a row the env excluded is ignored entirely
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "off")
+    assert cascade.resolve_mask(4, 512, True, prof)[0] == "sorted_sfs_mask"
+
+
+def test_pin_rules():
+    # unknown variant, wrong stage: refused
+    assert not cascade.pin("mask", "nonesuch", 4, 512)
+    assert not cascade.pin("flush", "mask_scan", 4, 512)
+    assert cascade.pin("mask", "mask_scan", 4, 512)
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    cascade.unpin("mask", 4, 512)
+    assert cascade.pinned("mask", 4, 512) is None
+
+
+def test_pin_hard_rule_requires_registered_oracle(monkeypatch):
+    # the audit-plane hard rule: deregistering a row's oracle makes it
+    # un-pinnable, no matter what the tuner learned
+    monkeypatch.delitem(cascade.ORACLES, "host_oracle")
+    assert not cascade.pin("mask", "mask_scan", 4, 512)
+    assert cascade.pinned("mask", 4, 512) is None
+
+
+# --------------------------------------------------------------------------
+# flush + merge + gate parity
+# --------------------------------------------------------------------------
+
+
+def test_flush_chooser_active(monkeypatch):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "off")
+    assert not cascade.flush_chooser_active(False)
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "auto")
+    assert cascade.flush_chooser_active(False)
+    assert not cascade.flush_chooser_active(True)  # meshed: never
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "auto")
+    assert cascade.flush_chooser_active(False)
+
+
+_FLUSH_GRID = [
+    # (mode, dc, meshed) -> path for device_variant="vmapped", fresh prof
+    ("off", "off", False, "vmapped"),
+    ("on", "off", False, "sorted_sfs"),
+    ("auto", "on", False, "device_cascade"),
+    ("off", "on", False, "device_cascade"),
+    ("auto", "off", False, "sorted_sfs"),   # fresh race explores sorted
+    ("auto", "auto", False, "sorted_sfs"),  # dc joins only when mode=off
+    ("off", "auto", False, "vmapped"),      # device SFS explored first
+    ("on", "on", True, "vmapped"),          # meshed: no alternatives
+]
+
+
+@pytest.mark.parametrize("mode,dc,meshed,path", _FLUSH_GRID)
+def test_resolve_flush_legacy_grid(monkeypatch, mode, dc, meshed, path):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", mode)
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", dc)
+    got = cascade.resolve_flush("vmapped", 4, 1000, meshed, _prof())
+    assert got == path, (mode, dc, meshed)
+
+
+def test_resolve_flush_ema_and_pin(monkeypatch):
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "off")
+    monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", "auto")
+    nb = n_bucket(1000)
+    prof = _prof({
+        ("flush_sfs_vmapped", 4, nb): 1.0,
+        ("flush_device_cascade", 4, nb): 5.0,
+    })
+    assert cascade.resolve_flush("vmapped", 4, 1000, False, prof) == "vmapped"
+    # the PR 18 scoping: the device cascade IS a candidate here, so a
+    # tuner pin on it takes effect...
+    assert cascade.pin("flush", "flush_device_cascade", 4, 1000)
+    assert (
+        cascade.resolve_flush("vmapped", 4, 1000, False, prof)
+        == "device_cascade"
+    )
+    # ...but never when the host cascade is in play (mode=auto)
+    monkeypatch.setenv("SKYLINE_SORTED_SFS", "auto")
+    assert (
+        cascade.resolve_flush("vmapped", 4, 1000, False, prof)
+        != "device_cascade"
+    )
+
+
+def test_merge_helpers(monkeypatch):
+    monkeypatch.setenv("SKYLINE_MERGE_CACHE", "1")
+    monkeypatch.setenv("SKYLINE_MERGE_TREE", "1")
+    assert cascade.merge_cache_on(False)
+    assert not cascade.merge_cache_on(True)  # meshed sets never cache
+    assert cascade.merge_tree_on(False, 4)
+    assert not cascade.merge_tree_on(False, 2)  # d<=2 never trees
+    assert not cascade.merge_tree_on(True, 4)
+    assert cascade.merge_path(True, True) == "tree_delta"
+    assert cascade.merge_path(False, True) == "delta"
+    assert cascade.merge_path(True, False) == "tree"
+    assert cascade.merge_path(False, False) == "flat"
+    assert cascade.delta_applies(0.3)
+    assert not cascade.delta_applies(0.0)
+    assert not cascade.delta_applies(0.76)  # legacy default cutoff 0.75
+
+
+def test_gate_override_and_env_priority(monkeypatch):
+    monkeypatch.setenv("SKYLINE_MERGE_PRUNE", "1")
+    # env pinned: the override is refused outright
+    assert not cascade.set_override("SKYLINE_MERGE_PRUNE", "0")
+    assert cascade.gate("partition_prune")
+    monkeypatch.delenv("SKYLINE_MERGE_PRUNE")
+    assert cascade.set_override("SKYLINE_MERGE_PRUNE", "0")
+    assert not cascade.gate("partition_prune")
+    # env wins at READ time: a mid-run export beats the standing override
+    monkeypatch.setenv("SKYLINE_MERGE_PRUNE", "1")
+    assert cascade.gate("partition_prune")
+
+
+def test_cutoff_override_and_env_priority(monkeypatch):
+    assert cascade.delta_cutoff() == pytest.approx(0.75)
+    assert not cascade.set_override("SKYLINE_MERGE_TREE", "0")  # not tunable
+    assert cascade.set_override("SKYLINE_DELTA_CUTOFF", "0.2")
+    assert cascade.delta_cutoff() == pytest.approx(0.2)
+    monkeypatch.setenv("SKYLINE_DELTA_CUTOFF", "0.5")
+    assert cascade.delta_cutoff() == pytest.approx(0.5)
+
+
+def test_applies_joins_gate_and_applicability(monkeypatch):
+    monkeypatch.setenv("SKYLINE_FLUSH_PREFILTER", "1")
+    assert cascade.applies("flush_prefilter", d=4, meshed=False)
+    assert not cascade.applies("flush_prefilter", d=2, meshed=False)
+    assert not cascade.applies("flush_prefilter", d=4, meshed=True)
+    monkeypatch.setenv("SKYLINE_FLUSH_PREFILTER", "0")
+    assert not cascade.applies("flush_prefilter", d=4, meshed=False)
+
+
+# --------------------------------------------------------------------------
+# 2. byte identity: every selectable mask row, same survivors
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["uniform", "correlated", "anti_correlated"])
+@pytest.mark.parametrize("d", [4, 8])
+def test_mask_rows_byte_identical(monkeypatch, rng, kind, d):
+    import jax.numpy as jnp
+
+    from skyline_tpu.ops.dispatch import skyline_mask_auto
+
+    x = gen_points(rng, 400, d, kind)
+    xj = jnp.asarray(x)
+    masks = {}
+    forcings = {
+        "mask_scan": ("off", "off"),
+        "sorted_sfs_mask": ("on", "off"),
+        "mask_device_cascade": ("off", "on"),
+    }
+    for row, (mode, dc) in forcings.items():
+        monkeypatch.setenv("SKYLINE_SORTED_SFS", mode)
+        monkeypatch.setenv("SKYLINE_DEVICE_CASCADE", dc)
+        masks[row] = np.asarray(skyline_mask_auto(xj))
+    ref = masks["mask_scan"]
+    for row, m in masks.items():
+        assert (m == ref).all(), f"{row} diverges from mask_scan ({kind})"
+    assert_same_set(x[ref], host_oracle(x))
+
+
+# --------------------------------------------------------------------------
+# 3. controller: DispatchTuner
+# --------------------------------------------------------------------------
+
+
+class _StubSlo:
+    def __init__(self):
+        self.ok = True
+
+    def evaluate(self):
+        return {"ok": self.ok}
+
+
+class _StubTelemetry:
+    def __init__(self):
+        self.counters = {}
+        self.flight = FlightRecorder(128)
+        self.slo = _StubSlo()
+        self.tuner = None
+
+    def inc(self, name, n=1):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+
+class _StubWorkload:
+    def __init__(self, kind="uniform", epoch=1):
+        self.kind, self.epoch = kind, epoch
+
+    def regime(self):
+        return {"kind": self.kind, "epoch": self.epoch}
+
+
+def _tuner(telem=None, workload=None, profiler=None, flush=None, t0=0.0):
+    clock_box = [t0]
+    t = DispatchTuner(
+        telemetry=telem,
+        workload=workload,
+        profiler=profiler,
+        flush_profiler=flush,
+        clock=lambda: clock_box[0],
+    )
+    return t, clock_box
+
+
+def test_tuner_passive_without_workload_evidence():
+    telem = _StubTelemetry()
+    tuner, _ = _tuner(telem, _StubWorkload(epoch=0))
+    assert not tuner.maybe_tune(now=100.0)
+    assert tuner.epochs == 0
+    # counter families registered at zero before any move
+    assert telem.counters["tuner.moves"] == 0
+
+
+def test_tuner_cadence_gates_epochs():
+    tuner, _ = _tuner(_StubTelemetry(), _StubWorkload())
+    assert tuner.maybe_tune(now=10.0)
+    assert not tuner.maybe_tune(now=11.0)  # within SKYLINE_TUNER_EPOCH_S
+    assert tuner.maybe_tune(now=20.0)
+    assert tuner.epochs == 2
+
+
+def test_tuner_pins_ema_winner_into_table():
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+    })
+    telem = _StubTelemetry()
+    tuner, _ = _tuner(telem, _StubWorkload(), profiler=prof)
+    assert tuner.maybe_tune(now=10.0)
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    assert tuner.moves == 1 and telem.counters["tuner.pins"] == 1
+    # stable winner: the next epoch makes no redundant move
+    assert tuner.maybe_tune(now=20.0)
+    assert tuner.moves == 1
+
+
+def test_tuner_moves_are_bounded(monkeypatch):
+    monkeypatch.setenv("SKYLINE_TUNER_MAX_MOVES", "1")
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+        ("mask_scan", 8, 1024): 1.0, ("sorted_sfs_mask", 8, 1024): 5.0,
+    })
+    tuner, _ = _tuner(_StubTelemetry(), _StubWorkload(), profiler=prof)
+    assert tuner.maybe_tune(now=10.0)
+    assert tuner.moves == 1  # second signature waits for the next epoch
+    assert tuner.maybe_tune(now=20.0)
+    assert tuner.moves == 2
+
+
+def test_tuner_single_measured_candidate_never_pins():
+    prof = _prof({("mask_scan", 4, 512): 1.0})
+    tuner, _ = _tuner(_StubTelemetry(), _StubWorkload(), profiler=prof)
+    assert tuner.maybe_tune(now=10.0)
+    assert tuner.moves == 0 and cascade.pinned("mask", 4, 512) is None
+
+
+def test_tuner_cutoff_moves_toward_observed_quantile():
+    telem = _StubTelemetry()
+    for _ in range(10):
+        telem.flight.note("merge.launch", path="flat", dirty_fraction=0.4)
+    tuner, _ = _tuner(telem, _StubWorkload())
+    assert tuner.maybe_tune(now=10.0)
+    # default 0.75 stepped (bounded: 0.1) toward p75=0.4 -> 0.65
+    assert cascade.delta_cutoff() == pytest.approx(0.65)
+    assert tuner.moves == 1
+    # env pinning the knob freezes the controller's hand
+    cascade.clear_override("SKYLINE_DELTA_CUTOFF")
+
+
+def test_tuner_cutoff_respects_env_pin(monkeypatch):
+    monkeypatch.setenv("SKYLINE_DELTA_CUTOFF", "0.9")
+    telem = _StubTelemetry()
+    for _ in range(10):
+        telem.flight.note("merge.launch", path="flat", dirty_fraction=0.2)
+    tuner, _ = _tuner(telem, _StubWorkload())
+    tuner.maybe_tune(now=10.0)
+    assert tuner.moves == 0
+    assert cascade.delta_cutoff() == pytest.approx(0.9)
+
+
+def test_tuner_hysteresis_gates_regime_switch(monkeypatch):
+    monkeypatch.setenv("SKYLINE_TUNER_HYSTERESIS", "2")
+    wl = _StubWorkload("uniform")
+    tuner, _ = _tuner(_StubTelemetry(), wl)
+    tuner.maybe_tune(now=10.0)
+    assert tuner.doc()["regime"] == "uniform"
+    wl.kind = "anti_correlated"
+    tuner.maybe_tune(now=20.0)
+    assert tuner.doc()["regime"] == "uniform"  # one epoch is noise
+    assert tuner.switches == 0
+    tuner.maybe_tune(now=30.0)
+    assert tuner.doc()["regime"] == "anti_correlated"
+    assert tuner.switches == 1
+
+
+def test_tuner_switch_resets_unvisited_regime_signatures(monkeypatch):
+    monkeypatch.setenv("SKYLINE_TUNER_HYSTERESIS", "1")
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+        ("flat", 4, 512): 2.0,  # merge-stage signature: never reset
+    })
+    wl = _StubWorkload("uniform")
+    tuner, _ = _tuner(_StubTelemetry(), wl, profiler=prof)
+    tuner.maybe_tune(now=10.0)
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    wl.kind = "correlated"
+    tuner.maybe_tune(now=20.0)
+    # first visit to the new regime: pins cleared, mask EMAs dropped so
+    # the race re-runs under the new distribution — merge rows untouched
+    assert cascade.pinned("mask", 4, 512) is None
+    assert prof.ema_ms("mask_scan", 4, 512) is None
+    assert prof.ema_ms("flat", 4, 512) is not None
+
+
+def test_tuner_banks_and_restores_per_regime_state(monkeypatch):
+    monkeypatch.setenv("SKYLINE_TUNER_HYSTERESIS", "1")
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+    })
+    wl = _StubWorkload("uniform")
+    tuner, _ = _tuner(_StubTelemetry(), wl, profiler=prof)
+    tuner.maybe_tune(now=10.0)
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    wl.kind = "correlated"
+    tuner.maybe_tune(now=20.0)  # banks uniform's pins, explores afresh
+    assert cascade.pinned("mask", 4, 512) is None
+    wl.kind = "uniform"
+    tuner.maybe_tune(now=30.0)  # returning: the banked pin swaps back in
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+
+
+def test_tuner_reverts_on_slo_burn():
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+    })
+    telem = _StubTelemetry()
+    tuner, _ = _tuner(telem, _StubWorkload(), profiler=prof)
+    tuner.maybe_tune(now=10.0)
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    telem.slo.ok = False
+    tuner.maybe_tune(now=20.0)  # burning: undo the newest move, freeze
+    assert cascade.pinned("mask", 4, 512) is None
+    assert tuner.reverts == 1
+    assert tuner.doc()["decisions"][-1]["action"] == "revert"
+
+
+def test_tuner_state_round_trip():
+    prof = _prof({
+        ("mask_scan", 4, 512): 1.0, ("sorted_sfs_mask", 4, 512): 5.0,
+    })
+    telem = _StubTelemetry()
+    for _ in range(10):
+        telem.flight.note("merge.launch", path="flat", dirty_fraction=0.4)
+    tuner, _ = _tuner(telem, _StubWorkload(), profiler=prof)
+    tuner.maybe_tune(now=10.0)
+    doc = json.loads(json.dumps(tuner.state_doc()))  # JSON-safe
+    assert doc["version"] == 1 and doc["pins"]
+    cascade.clear_pins()
+    cascade.clear_override("SKYLINE_DELTA_CUTOFF")
+    fresh, _ = _tuner(_StubTelemetry(), _StubWorkload())
+    assert fresh.restore(doc) == 1
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    assert cascade.delta_cutoff() == pytest.approx(0.65)
+    assert fresh.doc()["regime"] == "uniform"
+    # garbage is refused without touching the table
+    cascade.clear_pins()
+    assert fresh.restore({"version": 99}) == 0
+    assert fresh.restore("nonsense") == 0
+    assert cascade.pinned("mask", 4, 512) is None
+
+
+def test_dispatch_doc_shapes():
+    doc = dispatch_doc(None)
+    assert doc["tuner"] == {"enabled": False}
+    assert len(doc["table"]["rows"]) == len(cascade.TABLE)
+    telem = _StubTelemetry()
+    tuner, _ = _tuner(telem, _StubWorkload())
+    telem.tuner = tuner
+    doc = dispatch_doc(telem)
+    assert doc["tuner"]["enabled"] is True
+    json.dumps(doc)
+
+
+def test_tuner_prometheus_families_present():
+    telem = Telemetry()
+    DispatchTuner(telemetry=telem, workload=_StubWorkload())
+    text = telem.render_prometheus()
+    for fam in ("skyline_tuner_epochs_total", "skyline_tuner_moves_total",
+                "skyline_tuner_pins_total", "skyline_tuner_reverts_total",
+                "skyline_tuner_switches_total"):
+        assert fam in text
+
+
+def test_stage_variants_are_table_rows():
+    for stage, names in STAGE_VARIANTS.items():
+        for v in names:
+            assert cascade.ROW_BY_NAME[v].stage == stage
+
+
+# --------------------------------------------------------------------------
+# profiler persistence (satellite 1: the PR 18 cold-boot fix)
+# --------------------------------------------------------------------------
+
+
+def test_profiler_export_restore_round_trip():
+    src = KernelProfiler(backend="cpu")
+    with src.record("mask_scan", 4, 500):
+        pass
+    with src.record("mask_scan", 4, 500):
+        pass
+    doc = json.loads(json.dumps(src.export_state()))
+    dst = KernelProfiler(backend="cpu")
+    assert dst.restore_state(doc) == 1
+    assert dst.ema_ms("mask_scan", 4, 500) == pytest.approx(
+        src.ema_ms("mask_scan", 4, 500), rel=1e-3
+    )
+    # the cold-boot fix: a restored signature is MEASURED, so the sticky
+    # explore claim never re-runs its cold path
+    assert not dst.claim_explore("mask_scan", 4, 500)
+    # live data wins over a second restore
+    before = dst.ema_ms("mask_scan", 4, 500)
+    doc["entries"][0]["ema_ms"] = 999.0
+    assert dst.restore_state(doc) == 0
+    assert dst.ema_ms("mask_scan", 4, 500) == before
+
+
+def test_profiler_restore_skips_malformed_rows():
+    dst = KernelProfiler(backend="cpu")
+    assert dst.restore_state({"entries": [
+        {"variant": "mask_scan"},  # missing fields
+        {"variant": "mask_scan", "d": 4, "n_bucket": 512, "backend": "cpu",
+         "mp": False, "calls": 0, "wall_ms": 1, "ema_ms": 1,
+         "last_ms": 1},  # zero calls
+    ]}) == 0
+    assert dst.restore_state(None) == 0
+    assert dst.restore_state("junk") == 0
+
+
+def test_profiler_reset_signatures():
+    p = _prof({
+        ("mask_scan", 4, 512): 1.0, ("flat", 4, 512): 2.0,
+    })
+    assert p.reset_signatures(("mask_scan",)) == 1
+    assert p.ema_ms("mask_scan", 4, 512) is None
+    assert p.ema_ms("flat", 4, 512) is not None
+    assert p.reset_signatures() == 1  # None = everything
+    assert p.ema_ms("flat", 4, 512) is None
+
+
+# --------------------------------------------------------------------------
+# worker checkpoint round-trip of the learned-dispatch plane
+# --------------------------------------------------------------------------
+
+
+def test_worker_checkpoint_round_trips_dispatch_state(rng, tmp_path,
+                                                      monkeypatch):
+    from skyline_tpu.bridge import MemoryBus, SkylineWorker
+    from skyline_tpu.bridge.wire import format_tuple_line
+    from skyline_tpu.resilience import ResilienceConfig
+    from skyline_tpu.stream import EngineConfig
+
+    # a workload epoch must close on a 50-row stream for the tuner to act
+    monkeypatch.setenv("SKYLINE_WORKLOAD_EPOCH_ROWS", "32")
+
+    def make_worker():
+        return SkylineWorker(
+            MemoryBus(),
+            EngineConfig(parallelism=2, dims=4, domain_max=10000.0,
+                         buffer_size=128),
+            resilience=ResilienceConfig(
+                checkpoint_dir=str(tmp_path), checkpoint_interval_s=0.0
+            ),
+            telemetry=Telemetry(),
+        )
+
+    w = make_worker()
+    x = gen_points(rng, 50, 4, "uniform") * 10000.0
+    w.bus.produce_many(
+        "input-tuples",
+        [format_tuple_line(i, row) for i, row in enumerate(x)],
+    )
+    while w.step(max_records=64):
+        pass
+    # learned state: a measured mask signature + a tuner pin
+    w.engine.profiler.restore_state({"version": 1, "entries": [
+        {"variant": "mask_scan", "d": 4, "n_bucket": 512, "backend": "cpu",
+         "mp": False, "calls": 3, "wall_ms": 3.0, "ema_ms": 1.0,
+         "first_call_ms": 1.0, "last_ms": 1.0},
+        {"variant": "sorted_sfs_mask", "d": 4, "n_bucket": 512,
+         "backend": "cpu", "mp": False, "calls": 3, "wall_ms": 15.0,
+         "ema_ms": 5.0, "first_call_ms": 5.0, "last_ms": 5.0},
+    ]})
+    assert w.engine.tuner is not None
+    w.engine.tuner.maybe_tune(now=1e9)  # force one epoch past the cadence
+    assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    assert w.checkpoint_now() is not None
+    w.close()
+
+    # a restart with an empty table must come back tuned
+    cascade.clear_pins()
+    w2 = make_worker()
+    try:
+        assert w2.engine.profiler.ema_ms("mask_scan", 4, 512) is not None
+        assert not w2.engine.profiler.claim_explore("mask_scan", 4, 512)
+        assert cascade.pinned("mask", 4, 512) == "mask_scan"
+    finally:
+        w2.close()
